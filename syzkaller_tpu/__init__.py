@@ -1,0 +1,25 @@
+"""syzkaller_tpu: a TPU-native coverage-guided kernel-fuzzing framework.
+
+Syzkaller-class capabilities (typed syscall-program generation from
+declarative descriptions, coverage-guided mutation/triage, in-VM executor,
+crash detection/repro, VM-fleet manager, multi-manager corpus exchange) with
+the fuzzing brain implemented as batched JAX/XLA kernels over fixed-width
+program tensors. See SURVEY.md at the repo root for the structural map.
+
+Layout:
+  descriptions/  syscall description language -> Target -> numpy tables
+  prog/          host-side program IR, text + exec serialization, tensors
+  ops/           JAX kernels: rng, mutation, generation, prio, cover, hints
+  parallel/      device mesh, sharded coverage collectives
+  engine/        the fuzzing loop (corpus-as-tensors, triage)
+  ipc/ executor/ shared-memory protocol + C++ in-VM executor
+  manager/ vm/   host orchestrator, VM-fleet backends
+  report/ repro/ crash parsing and automated reproduction
+"""
+
+# NOTE: importing the top-level package stays jax-free so the description
+# pipeline and program IR work standalone; the device modules
+# (ops/, parallel/, engine/, models/) call utils.jaxcfg.ensure_x64() which
+# enables 64-bit lanes (program words and signal hashes are u64/u32).
+
+__version__ = "0.1.0"

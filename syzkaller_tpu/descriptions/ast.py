@@ -1,0 +1,136 @@
+"""AST for the syscall description language.
+
+The language is source-compatible with the reference's description syntax
+(reference: /root/reference/pkg/ast/parser.go, /root/reference/sys/linux/*.txt)
+so existing description corpora can be brought over: resources, flags,
+string-flags, structs/unions with attributes, syscall variants (`name$tag`),
+and the builtin type constructors (ptr, array, buffer, string, filename, len,
+bytesize, const, flags, proc, csum, vma, text, int*).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple, Union
+
+
+@dataclass
+class Pos:
+    file: str = ""
+    line: int = 0
+
+    def __str__(self) -> str:
+        return f"{self.file}:{self.line}"
+
+
+@dataclass
+class IntLit:
+    value: int
+    pos: Pos = field(default_factory=Pos)
+
+
+@dataclass
+class StrLit:
+    value: str
+    pos: Pos = field(default_factory=Pos)
+
+
+@dataclass
+class IntRange:
+    begin: "Expr"
+    end: "Expr"
+    pos: Pos = field(default_factory=Pos)
+
+
+@dataclass
+class Ident:
+    name: str
+    pos: Pos = field(default_factory=Pos)
+
+
+# A constant expression: literal int or symbolic const name.
+Expr = Union[IntLit, Ident]
+
+
+@dataclass
+class TypeExpr:
+    """`name[arg, arg, ...]:bitfield_len` — args may themselves be types,
+    literals, or ranges."""
+
+    name: str
+    args: List[Union["TypeExpr", IntLit, StrLit, IntRange, Ident]] = field(
+        default_factory=list)
+    bitfield_len: Optional[Expr] = None
+    pos: Pos = field(default_factory=Pos)
+
+
+@dataclass
+class Field:
+    name: str
+    typ: TypeExpr
+    pos: Pos = field(default_factory=Pos)
+
+
+@dataclass
+class CallDef:
+    name: str  # full variant name, e.g. "open$dir"
+    call_name: str  # base, e.g. "open"
+    fields: List[Field] = field(default_factory=list)
+    ret: Optional[TypeExpr] = None
+    pos: Pos = field(default_factory=Pos)
+
+
+@dataclass
+class ResourceDef:
+    name: str
+    base: TypeExpr = None
+    values: List[Expr] = field(default_factory=list)
+    pos: Pos = field(default_factory=Pos)
+
+
+@dataclass
+class FlagsDef:
+    name: str
+    values: List[Expr] = field(default_factory=list)
+    pos: Pos = field(default_factory=Pos)
+
+
+@dataclass
+class StrFlagsDef:
+    name: str
+    values: List[str] = field(default_factory=list)
+    pos: Pos = field(default_factory=Pos)
+
+
+@dataclass
+class StructDef:
+    name: str
+    fields: List[Field] = field(default_factory=list)
+    is_union: bool = False
+    attrs: List[str] = field(default_factory=list)
+    pos: Pos = field(default_factory=Pos)
+
+
+@dataclass
+class DefineDef:
+    name: str
+    expr: str  # raw expression text, resolved against the const table
+    pos: Pos = field(default_factory=Pos)
+
+
+@dataclass
+class IncludeDef:
+    path: str
+    pos: Pos = field(default_factory=Pos)
+
+
+Node = Union[CallDef, ResourceDef, FlagsDef, StrFlagsDef, StructDef,
+             DefineDef, IncludeDef]
+
+
+@dataclass
+class Description:
+    nodes: List[Node] = field(default_factory=list)
+
+    def extend(self, other: "Description") -> None:
+        self.nodes.extend(other.nodes)

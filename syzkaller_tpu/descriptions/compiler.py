@@ -1,0 +1,589 @@
+"""Compiler: description AST -> typed Target.
+
+Performs what the reference splits across pkg/compiler (check/consts/gen:
+/root/reference/pkg/compiler/compiler.go:45) and syz-sysgen: const
+resolution, type instantiation per use-direction, struct layout (natural
+alignment padding, bitfield grouping, packed/align attributes), resource
+kind chains, and syscall-number binding. Instead of emitting generated Go
+source like sysgen, the result is a live `Target`; the flat numpy tables the
+TPU kernels index are derived from it in `.tables`.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Dict, List, Optional, Tuple, Union
+
+from ..prog.target import Target
+from ..prog.types import (
+    ArrayKind,
+    ArrayType,
+    BufferKind,
+    BufferType,
+    ConstType,
+    CsumKind,
+    CsumType,
+    Dir,
+    FlagsType,
+    IntKind,
+    IntType,
+    LenType,
+    ProcType,
+    PtrType,
+    ResourceDesc,
+    ResourceType,
+    StructType,
+    Syscall,
+    TextKind,
+    Type,
+    UnionType,
+    VmaType,
+)
+from . import ast
+from .ast import (
+    CallDef,
+    DefineDef,
+    Description,
+    FlagsDef,
+    Ident,
+    IntLit,
+    IntRange,
+    ResourceDef,
+    StrFlagsDef,
+    StrLit,
+    StructDef,
+    TypeExpr,
+)
+
+PSEUDO_NR_BASE = 1 << 30  # syz_* pseudo-syscalls, dispatched by name
+
+_INT_SIZES = {"int8": 1, "int16": 2, "int32": 4, "int64": 8,
+              "int16be": 2, "int32be": 4, "int64be": 8}
+
+_TEXT_KINDS = {"x86_real": TextKind.X86_REAL, "x86_16": TextKind.X86_16,
+               "x86_32": TextKind.X86_32, "x86_64": TextKind.X86_64,
+               "arm64": TextKind.ARM64}
+
+_DIRS = {"in": Dir.IN, "out": Dir.OUT, "inout": Dir.INOUT}
+
+
+class CompileError(Exception):
+    pass
+
+
+class Compiler:
+    def __init__(self, desc: Description, consts: Dict[str, int], *,
+                 os: str = "linux", arch: str = "amd64", ptr_size: int = 8,
+                 page_size: int = 4096):
+        self.desc = desc
+        self.consts = dict(consts)
+        self.os = os
+        self.arch = arch
+        self.ptr_size = ptr_size
+        self.page_size = page_size
+
+        self.resources: Dict[str, ResourceDef] = {}
+        self.structs: Dict[str, StructDef] = {}
+        self.flags: Dict[str, FlagsDef] = {}
+        self.strflags: Dict[str, StrFlagsDef] = {}
+        self.calls: List[CallDef] = []
+        self.warnings: List[str] = []
+        self.unsupported: List[str] = []
+
+        self._struct_memo: Dict[Tuple[str, Dir], Type] = {}
+        # (name, dir) -> copies handed out while the struct is mid-build;
+        # patched in place when its layout completes (recursive descriptions).
+        self._struct_pending: Dict[Tuple[str, Dir], list] = {}
+        self._res_desc_memo: Dict[str, ResourceDesc] = {}
+
+    # ------------------------------------------------------------------ #
+
+    def compile(self) -> Target:
+        self._index_nodes()
+        self._resolve_defines()
+
+        resources: List[ResourceDesc] = []
+        for name in self.resources:
+            resources.append(self._resource_desc(name))
+
+        syscalls: List[Syscall] = []
+        pseudo_idx = 0
+        for cd in self.calls:
+            try:
+                args = tuple(
+                    self._make_type(f.typ, Dir.IN, f.name, is_arg=True)
+                    for f in cd.fields)
+                ret: Optional[Type] = None
+                if cd.ret is not None:
+                    rt = self._make_type(cd.ret, Dir.OUT, "ret", is_arg=True)
+                    if isinstance(rt, ResourceType):
+                        ret = rt
+                    # non-resource returns carry no dataflow: drop them
+            except _SkipCall as e:
+                self.unsupported.append(f"{cd.name}: {e}")
+                continue
+            if cd.call_name.startswith("syz_"):
+                nr = PSEUDO_NR_BASE + pseudo_idx
+                pseudo_idx += 1
+            else:
+                nr = self.consts.get(f"__NR_{cd.call_name}")
+                if nr is None:
+                    self.unsupported.append(f"{cd.name}: no __NR_{cd.call_name}")
+                    continue
+            syscalls.append(Syscall(
+                id=len(syscalls), nr=nr, name=cd.name,
+                call_name=cd.call_name, args=args, ret=ret))
+
+        target = Target(
+            self.os, self.arch, ptr_size=self.ptr_size,
+            page_size=self.page_size, syscalls=syscalls,
+            resources=resources, consts=self.consts)
+        return target
+
+    # ------------------------------------------------------------------ #
+
+    def _index_nodes(self) -> None:
+        for n in self.desc.nodes:
+            if isinstance(n, ResourceDef):
+                self.resources[n.name] = n
+            elif isinstance(n, StructDef):
+                self.structs[n.name] = n
+            elif isinstance(n, FlagsDef):
+                self.flags[n.name] = n
+            elif isinstance(n, StrFlagsDef):
+                self.strflags[n.name] = n
+            elif isinstance(n, CallDef):
+                self.calls.append(n)
+
+    def _resolve_defines(self) -> None:
+        pending = [n for n in self.desc.nodes if isinstance(n, DefineDef)]
+        for _ in range(len(pending) + 1):
+            remaining = []
+            for d in pending:
+                try:
+                    self.consts[d.name] = int(
+                        eval(d.expr, {"__builtins__": {}}, self.consts))
+                except Exception:
+                    remaining.append(d)
+            if not remaining:
+                return
+            if len(remaining) == len(pending):
+                for d in remaining:
+                    self.warnings.append(f"{d.pos}: cannot resolve define {d.name}")
+                return
+            pending = remaining
+
+    def _const(self, e: Union[IntLit, Ident, TypeExpr], where: str) -> int:
+        if isinstance(e, IntLit):
+            return e.value
+        name = e.name
+        if name in self.consts:
+            return self.consts[name]
+        raise _SkipCall(f"unknown const {name!r} in {where}")
+
+    # ------------------------------------------------------------------ #
+
+    def _resource_desc(self, name: str) -> ResourceDesc:
+        if name in self._res_desc_memo:
+            return self._res_desc_memo[name]
+        rd = self.resources.get(name)
+        if rd is None:
+            raise CompileError(f"unknown resource {name!r}")
+        base_name = rd.base.name
+        if base_name in self.resources:
+            parent = self._resource_desc(base_name)
+            kind = parent.kind + (name,)
+            base_typ = parent.typ
+            inherited = parent.values
+        elif base_name in _INT_SIZES or base_name == "intptr":
+            kind = (name,)
+            base_typ = self._int_type(rd.base, Dir.IN, name)
+            inherited = ()
+        else:
+            raise CompileError(
+                f"{rd.pos}: resource {name} has bad base {base_name}")
+        values: List[int] = []
+        for v in rd.values:
+            try:
+                values.append(self._const(v, f"resource {name}"))
+            except _SkipCall:
+                self.warnings.append(f"{rd.pos}: dropping value in resource {name}")
+        if not values:
+            values = list(inherited) or [0]
+        desc = ResourceDesc(name=name, typ=base_typ, kind=kind,
+                            values=tuple(values))
+        self._res_desc_memo[name] = desc
+        return desc
+
+    # ------------------------------------------------------------------ #
+
+    def _int_type(self, te: TypeExpr, dir: Dir, fname: str) -> IntType:
+        size = self.ptr_size if te.name == "intptr" else _INT_SIZES[te.name]
+        big = te.name.endswith("be")
+        kind, rb, re_ = IntKind.PLAIN, 0, 0
+        args = _strip_opt(te.args)[0]
+        if args:
+            a = args[0]
+            if isinstance(a, IntRange):
+                kind = IntKind.RANGE
+                rb = self._const(a.begin, fname)
+                re_ = self._const(a.end, fname)
+            else:
+                kind = IntKind.RANGE
+                rb = re_ = self._const(a, fname)
+        bf = 0
+        if te.bitfield_len is not None:
+            bf = self._const(te.bitfield_len, fname)
+            if bf > size * 8:
+                raise CompileError(f"{te.pos}: bitfield of {bf} bits in {te.name}")
+        return IntType(name=te.name, field_name=fname, size=size, dir=dir,
+                       big_endian=big, kind=kind, range_begin=rb, range_end=re_,
+                       bitfield_len=bf)
+
+    def _base_type(self, args: list, dir: Dir, fname: str, *,
+                   default_size: Optional[int] = None) -> IntType:
+        """Last arg may be an int base type; default intptr."""
+        for a in reversed(args):
+            if isinstance(a, TypeExpr) and (a.name in _INT_SIZES or
+                                            a.name == "intptr"):
+                return self._int_type(a, dir, fname)
+        size = default_size if default_size is not None else self.ptr_size
+        return IntType(name="intptr", field_name=fname, size=size, dir=dir)
+
+    def _make_type(self, te: TypeExpr, dir: Dir, fname: str,
+                   is_arg: bool = False) -> Type:
+        args, opt = _strip_opt(te.args)
+        name = te.name
+
+        if name in _INT_SIZES or name == "intptr":
+            t = self._int_type(te, dir, fname)
+            return replace(t, optional=opt)
+
+        if name == "const":
+            if not args:
+                raise CompileError(f"{te.pos}: const needs a value")
+            val = self._const(args[0], fname)
+            base = self._base_type(args[1:], dir, fname)
+            return ConstType(name="const", field_name=fname, size=base.size,
+                             dir=dir, optional=opt, big_endian=base.big_endian,
+                             val=val,
+                             bitfield_len=self._bf(te, fname))
+
+        if name == "flags":
+            if not args or not isinstance(args[0], TypeExpr):
+                raise CompileError(f"{te.pos}: flags needs a flag-set name")
+            fl = self.flags.get(args[0].name)
+            if fl is None:
+                raise _SkipCall(f"unknown flags {args[0].name!r}")
+            vals = []
+            for v in fl.values:
+                try:
+                    vals.append(self._const(v, f"flags {fl.name}"))
+                except _SkipCall:
+                    self.warnings.append(
+                        f"{fl.pos}: dropping unknown const in flags {fl.name}")
+            base = self._base_type(args[1:], dir, fname)
+            if not vals:
+                return replace(base, field_name=fname, optional=opt)
+            return FlagsType(name=fl.name, field_name=fname, size=base.size,
+                             dir=dir, optional=opt, big_endian=base.big_endian,
+                             vals=tuple(vals), bitfield_len=self._bf(te, fname))
+
+        if name in ("len", "bytesize", "bytesize2", "bytesize4", "bytesize8"):
+            if not args or not isinstance(args[0], TypeExpr):
+                raise CompileError(f"{te.pos}: {name} needs a target field")
+            byte_size = {"len": 0, "bytesize": 1, "bytesize2": 2,
+                         "bytesize4": 4, "bytesize8": 8}[name]
+            base = self._base_type(args[1:], dir, fname)
+            return LenType(name=name, field_name=fname, size=base.size, dir=dir,
+                           optional=opt, big_endian=base.big_endian,
+                           buf=args[0].name, byte_size=byte_size,
+                           bitfield_len=self._bf(te, fname))
+
+        if name == "proc":
+            if len(args) < 2:
+                raise CompileError(f"{te.pos}: proc[start, perproc, base?]")
+            start = self._const(args[0], fname)
+            per = self._const(args[1], fname)
+            base = self._base_type(args[2:], dir, fname)
+            return ProcType(name="proc", field_name=fname, size=base.size,
+                            dir=dir, optional=opt, big_endian=base.big_endian,
+                            values_start=start, values_per_proc=per)
+
+        if name == "csum":
+            if len(args) < 2 or not isinstance(args[0], TypeExpr) \
+                    or not isinstance(args[1], TypeExpr):
+                raise CompileError(f"{te.pos}: csum[buf, kind, ...]")
+            kind_name = args[1].name
+            protocol = 0
+            rest = args[2:]
+            if kind_name == "inet":
+                kind = CsumKind.INET
+            elif kind_name == "pseudo":
+                kind = CsumKind.PSEUDO
+                if rest:
+                    protocol = self._const(rest[0], fname)
+                    rest = rest[1:]
+            else:
+                raise CompileError(f"{te.pos}: bad csum kind {kind_name}")
+            base = self._base_type(rest, dir, fname)
+            return CsumType(name="csum", field_name=fname, size=base.size,
+                            dir=dir, big_endian=base.big_endian, kind=kind,
+                            buf=args[0].name, protocol=protocol)
+
+        if name == "fileoff":
+            base = self._base_type(args, dir, fname)
+            return replace(base, name="fileoff", kind=IntKind.FILEOFF,
+                           field_name=fname, optional=opt)
+
+        if name == "vma":
+            rb = re_ = 0
+            if args:
+                a = args[0]
+                if isinstance(a, IntRange):
+                    rb = self._const(a.begin, fname)
+                    re_ = self._const(a.end, fname)
+                else:
+                    rb = re_ = self._const(a, fname)
+            return VmaType(name="vma", field_name=fname, size=self.ptr_size,
+                           dir=dir, optional=opt, range_begin=rb, range_end=re_)
+
+        if name == "ptr":
+            if len(args) < 2 or not isinstance(args[0], TypeExpr):
+                raise CompileError(f"{te.pos}: ptr[dir, type]")
+            pdir = _DIRS.get(args[0].name)
+            if pdir is None:
+                raise CompileError(f"{te.pos}: bad ptr direction {args[0].name}")
+            elem = self._make_type(args[1], pdir, fname)
+            return PtrType(name="ptr", field_name=fname, size=self.ptr_size,
+                           dir=dir, optional=opt, elem=elem)
+
+        if name == "buffer":
+            if not args or not isinstance(args[0], TypeExpr):
+                raise CompileError(f"{te.pos}: buffer[dir]")
+            pdir = _DIRS.get(args[0].name)
+            if pdir is None:
+                raise CompileError(f"{te.pos}: bad buffer direction {args[0].name}")
+            blob = BufferType(name="buffer", field_name=fname, size=0, dir=pdir,
+                              kind=BufferKind.BLOB_RAND)
+            return PtrType(name="ptr", field_name=fname, size=self.ptr_size,
+                           dir=dir, optional=opt, elem=blob)
+
+        if name in ("string", "stringnoz"):
+            noz = name == "stringnoz"
+            values: Tuple[str, ...] = ()
+            sub_kind = ""
+            fixed = 0
+            for a in args:
+                if isinstance(a, StrLit):
+                    values = values + (a.value,)
+                elif isinstance(a, TypeExpr) and a.name in self.strflags:
+                    sub_kind = a.name
+                    values = values + tuple(self.strflags[a.name].values)
+                elif isinstance(a, (IntLit, Ident)):
+                    fixed = self._const(a, fname)
+                else:
+                    raise CompileError(f"{te.pos}: bad string arg")
+            bvals = tuple(v + ("" if noz else "\x00") for v in values)
+            size = fixed
+            if not size and bvals:
+                sizes = {len(v) for v in bvals}
+                if len(sizes) == 1:
+                    size = sizes.pop()
+            return BufferType(name=name, field_name=fname, size=size, dir=dir,
+                              optional=opt, kind=BufferKind.STRING,
+                              sub_kind=sub_kind, values=bvals)
+
+        if name == "filename":
+            return BufferType(name="filename", field_name=fname, size=0,
+                              dir=dir, optional=opt, kind=BufferKind.FILENAME)
+
+        if name == "text":
+            if not args or not isinstance(args[0], TypeExpr) \
+                    or args[0].name not in _TEXT_KINDS:
+                raise CompileError(f"{te.pos}: text[kind]")
+            return BufferType(name="text", field_name=fname, size=0, dir=dir,
+                              kind=BufferKind.TEXT, text=_TEXT_KINDS[args[0].name])
+
+        if name == "array":
+            if not args or not isinstance(args[0], TypeExpr):
+                raise CompileError(f"{te.pos}: array[type, len?]")
+            elem = self._make_type(args[0], dir, fname)
+            kind, rb, re_ = ArrayKind.RAND_LEN, 0, 0
+            if len(args) > 1:
+                a = args[1]
+                kind = ArrayKind.RANGE_LEN
+                if isinstance(a, IntRange):
+                    rb = self._const(a.begin, fname)
+                    re_ = self._const(a.end, fname)
+                else:
+                    rb = re_ = self._const(a, fname)
+            size = 0
+            if kind == ArrayKind.RANGE_LEN and rb == re_ and not elem.is_varlen:
+                size = rb * elem.size
+            # special case: array[int8] buffers degrade to blobs (byte arenas)
+            if isinstance(elem, IntType) and elem.size == 1 \
+                    and elem.kind == IntKind.PLAIN:
+                bkind = BufferKind.BLOB_RAND
+                if kind == ArrayKind.RANGE_LEN:
+                    bkind = BufferKind.BLOB_RANGE
+                return BufferType(name="array", field_name=fname, size=size,
+                                  dir=dir, optional=opt, kind=bkind,
+                                  range_begin=rb, range_end=re_)
+            return ArrayType(name="array", field_name=fname, size=size, dir=dir,
+                             optional=opt, elem=elem, kind=kind,
+                             range_begin=rb, range_end=re_)
+
+        if name in self.resources:
+            desc = self._resource_desc(name)
+            return ResourceType(name=name, field_name=fname,
+                                size=desc.typ.size, dir=dir, optional=opt,
+                                desc=desc)
+
+        if name in self.structs:
+            return self._struct_type(name, dir, fname, opt)
+
+        if name == "bool8":
+            return IntType(name="bool8", field_name=fname, size=1, dir=dir,
+                           kind=IntKind.RANGE, range_begin=0, range_end=1)
+
+        raise CompileError(f"{te.pos}: unknown type {name!r}")
+
+    def _bf(self, te: TypeExpr, fname: str) -> int:
+        return self._const(te.bitfield_len, fname) if te.bitfield_len else 0
+
+    # ------------------------------------------------------------------ #
+
+    def _struct_type(self, name: str, dir: Dir, fname: str, opt: bool) -> Type:
+        key = (name, dir)
+        if key in self._struct_memo:
+            copy = replace(self._struct_memo[key], field_name=fname,
+                           optional=opt)
+            if key in self._struct_pending:
+                # Recursive reference while the struct is still being built:
+                # its fields/size aren't known yet, so register this copy to
+                # be patched once the definition completes.
+                self._struct_pending[key].append(copy)
+            return copy
+        sd = self.structs[name]
+        if sd.is_union:
+            shell = UnionType(name=name, field_name=fname, size=0, dir=dir)
+        else:
+            shell = StructType(name=name, field_name=fname, size=0, dir=dir)
+        self._struct_memo[key] = shell
+        self._struct_pending[key] = []
+
+        fields = tuple(self._make_type(f.typ, dir, f.name) for f in sd.fields)
+        patch: Dict[str, object] = {}
+        if sd.is_union:
+            varlen = any(f.is_varlen for f in fields) or \
+                len({f.size for f in fields}) > 1
+            patch = {"fields": fields, "size": 0 if varlen else fields[0].size}
+        else:
+            packed = "packed" in sd.attrs
+            align_attr = 0
+            for a in sd.attrs:
+                if a.startswith("align_"):
+                    align_attr = int(a[len("align_"):], 0)
+            fields, size, varlen = self._layout_struct(fields, packed, align_attr)
+            patch = {"fields": fields, "size": 0 if varlen else size,
+                     "align_attr": align_attr, "packed": packed}
+        for inst in [shell] + self._struct_pending.pop(key):
+            for k, v in patch.items():
+                object.__setattr__(inst, k, v)
+        return replace(shell, field_name=fname, optional=opt)
+
+    def _layout_struct(self, fields: Tuple[Type, ...], packed: bool,
+                       align_attr: int):
+        """Insert alignment padding and assign bitfield offsets.
+
+        Returns (fields_with_pads, static_size, varlen)."""
+        out: List[Type] = []
+        offset = 0
+        varlen = False
+        max_align = 1
+        i = 0
+        fields = list(fields)
+        while i < len(fields):
+            f = fields[i]
+            # bitfield group: consecutive int-like fields with bitfield_len
+            if getattr(f, "bitfield_len", 0):
+                unit = f.size
+                bits = 0
+                group = []
+                while i < len(fields):
+                    g = fields[i]
+                    gl = getattr(g, "bitfield_len", 0)
+                    if not gl or g.size != unit or bits + gl > unit * 8:
+                        break
+                    group.append((g, bits))
+                    bits += gl
+                    i += 1
+                for j, (g, off_bits) in enumerate(group):
+                    middle = j != len(group) - 1
+                    out.append(replace(g, bitfield_off=off_bits,
+                                       bitfield_mdl=middle))
+                offset += unit
+                max_align = max(max_align, unit)
+                continue
+            al = 1 if packed else self._type_align(f)
+            max_align = max(max_align, al)
+            if not varlen and al > 1 and offset % al:
+                pad = al - offset % al
+                out.append(ConstType(name="pad", field_name=f"_pad{offset}",
+                                     size=pad, dir=f.dir, is_pad=True))
+                offset += pad
+            out.append(f)
+            if f.is_varlen:
+                varlen = True
+            else:
+                offset += f.size
+            i += 1
+        struct_align = align_attr or (1 if packed else max_align)
+        if not varlen and struct_align > 1 and offset % struct_align:
+            pad = struct_align - offset % struct_align
+            out.append(ConstType(name="pad", field_name=f"_pad{offset}",
+                                 size=pad, dir=Dir.IN, is_pad=True))
+            offset += pad
+        return tuple(out), offset, varlen
+
+    def _type_align(self, t: Type) -> int:
+        if isinstance(t, (PtrType, VmaType)):
+            return self.ptr_size
+        if isinstance(t, BufferType):
+            return 1
+        if isinstance(t, ArrayType):
+            return self._type_align(t.elem)
+        if isinstance(t, StructType):
+            if t.align_attr:
+                return t.align_attr
+            if t.packed:
+                return 1
+            return max((self._type_align(f) for f in t.fields), default=1)
+        if isinstance(t, UnionType):
+            return max((self._type_align(f) for f in t.fields), default=1)
+        if isinstance(t, ResourceType):
+            return t.desc.typ.size
+        sz = t.size
+        return sz if sz in (1, 2, 4, 8) else 8
+
+
+class _SkipCall(Exception):
+    """A call references something unresolvable; it is recorded as
+    unsupported rather than failing the whole compile (matches the
+    reference's disabled-syscall behavior)."""
+
+
+def _strip_opt(args: list) -> Tuple[list, bool]:
+    opt = False
+    out = []
+    for a in args:
+        if isinstance(a, TypeExpr) and a.name == "opt" and not a.args:
+            opt = True
+        else:
+            out.append(a)
+    return out, opt
+
+
+def compile_description(desc: Description, consts: Dict[str, int], **kw) -> Target:
+    return Compiler(desc, consts, **kw).compile()

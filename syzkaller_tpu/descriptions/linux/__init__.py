@@ -1,0 +1,107 @@
+"""Bundled linux target: descriptions + consts + arch hooks.
+
+Plays the role of the reference's generated sys/linux/<arch>.go +
+sys/linux/init.go (reference: /root/reference/sys/linux/init.go:12-60,148):
+compiles the bundled description files at first use and registers a Target
+with the mmap/sanitize hooks wired in.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from ...prog import prog as progmod
+from ...prog.target import Target, register_target, _targets
+from ...prog.types import Dir
+from ..compiler import compile_description
+from ..parser import parse_files
+
+_HERE = Path(__file__).parent
+
+DATA_OFFSET = 512 << 20
+PAGE_SIZE = 4 << 10
+NUM_PAGES = 4 << 10
+
+STRING_DICTIONARY = [
+    "user", "self", "proc", "sysfs", "cgroup", "tmpfs", "lo", "eth0",
+    "wlan0", "ppp0", "nodev", "security", "trusted", "system", "keyring",
+    "GPL", "md5sum", "mime_type",
+]
+
+
+def build_target(arch: str = "amd64") -> Target:
+    consts = json.loads((_HERE / f"consts_{arch}.json").read_text())
+    desc = parse_files(sorted(_HERE.glob("*.txt")))
+    target = compile_description(desc, consts, os="linux", arch=arch,
+                                 ptr_size=8, page_size=PAGE_SIZE)
+    target.data_offset = DATA_OFFSET
+    target.num_pages = NUM_PAGES
+    _init_arch(target)
+    return target
+
+
+def _init_arch(target: Target) -> None:
+    mmap = target.syscall_map.get("mmap")
+    target.mmap_syscall = mmap
+    cm = target.consts
+    prot_rw = cm["PROT_READ"] | cm["PROT_WRITE"]
+    map_flags = cm["MAP_ANONYMOUS"] | cm["MAP_PRIVATE"] | cm["MAP_FIXED"]
+    invalid_fd = (1 << 64) - 1
+
+    def make_mmap(start: int, npages: int) -> progmod.Call:
+        return progmod.Call(
+            meta=mmap,
+            args=[
+                progmod.PointerArg(mmap.args[0], start, 0, npages, None),
+                progmod.ConstArg(mmap.args[1], npages * target.page_size),
+                progmod.ConstArg(mmap.args[2], prot_rw),
+                progmod.ConstArg(mmap.args[3], map_flags),
+                progmod.make_result_arg(mmap.args[4], None, invalid_fd),
+                progmod.ConstArg(mmap.args[5], 0),
+            ],
+            ret=progmod.ReturnArg(mmap.ret) if mmap.ret else progmod.ReturnArg(None),
+        )
+
+    def analyze_mmap(c: progmod.Call):
+        name = c.meta.name
+        if name == "mmap":
+            npages = c.args[1].val // target.page_size
+            if npages == 0:
+                return 0, 0, False
+            flags = c.args[3].val
+            fd_val = getattr(c.args[4], "val", 0)
+            if flags & cm["MAP_ANONYMOUS"] == 0 and fd_val == invalid_fd:
+                return 0, 0, False
+            return c.args[0].page_index, npages, True
+        if name == "munmap":
+            return c.args[0].page_index, c.args[1].val // target.page_size, False
+        if name == "mremap":
+            return c.args[4].page_index, c.args[2].val // target.page_size, True
+        return 0, 0, False
+
+    def sanitize_call(c: progmod.Call) -> None:
+        cn = c.meta.call_name
+        if cn == "mmap":
+            # Force MAP_FIXED for deterministic replay.
+            c.args[3].val |= cm["MAP_FIXED"]
+        elif cn == "mremap":
+            if c.args[3].val & cm["MREMAP_MAYMOVE"]:
+                c.args[3].val |= cm["MREMAP_FIXED"]
+        elif cn in ("exit", "exit_group"):
+            # Status codes 67/68 are reserved by the executor protocol.
+            if c.args and c.args[0].val % 128 in (67, 68):
+                c.args[0].val = 1
+
+    if mmap is not None:
+        target.make_mmap = make_mmap
+        target.analyze_mmap = analyze_mmap
+    target.sanitize_call = sanitize_call
+    target.string_dictionary = list(STRING_DICTIONARY)
+
+
+def ensure_registered(arch: str = "amd64") -> Target:
+    key = f"linux/{arch}"
+    if key not in _targets:
+        register_target(build_target(arch))
+    return _targets[key]

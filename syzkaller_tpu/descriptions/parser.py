@@ -1,0 +1,308 @@
+"""Recursive-descent parser for the syscall description language.
+
+Source-compatible with the reference description syntax (reference:
+/root/reference/pkg/ast/parser.go:17-50 and sys/linux/*.txt). Line-oriented:
+every top-level construct starts on its own line; structs/unions span lines
+until the closing brace/bracket.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Union
+
+from .ast import (
+    CallDef,
+    DefineDef,
+    Description,
+    Field,
+    FlagsDef,
+    Ident,
+    IncludeDef,
+    IntLit,
+    IntRange,
+    Pos,
+    ResourceDef,
+    StrFlagsDef,
+    StrLit,
+    StructDef,
+    TypeExpr,
+)
+
+
+class ParseError(Exception):
+    def __init__(self, pos: Pos, msg: str):
+        super().__init__(f"{pos}: {msg}")
+        self.pos = pos
+
+
+_TOKEN_RE = re.compile(
+    r"""
+    (?P<ws>\s+)
+  | (?P<comment>\#.*)
+  | (?P<string>"(?:[^"\\]|\\.)*")
+  | (?P<char>'(?:[^'\\]|\\.)')
+  | (?P<number>-?(?:0x[0-9a-fA-F]+|\d+))
+  | (?P<ident>[a-zA-Z_][a-zA-Z0-9_$]*)
+  | (?P<punct>[()\[\]{}:,=<>])
+""",
+    re.VERBOSE,
+)
+
+
+class _Lexer:
+    def __init__(self, text: str, pos: Pos):
+        self.pos = pos
+        self.toks: List[tuple] = []  # (kind, value)
+        i = 0
+        while i < len(text):
+            m = _TOKEN_RE.match(text, i)
+            if not m:
+                raise ParseError(pos, f"bad character {text[i]!r}")
+            i = m.end()
+            kind = m.lastgroup
+            if kind in ("ws", "comment"):
+                continue
+            val = m.group()
+            if kind == "number":
+                self.toks.append(("number", int(val, 0)))
+            elif kind == "string":
+                self.toks.append(("string", _unescape(val[1:-1])))
+            elif kind == "char":
+                self.toks.append(("number", ord(_unescape(val[1:-1]))))
+            else:
+                self.toks.append((kind, val))
+        self.i = 0
+
+    def peek(self) -> Optional[tuple]:
+        return self.toks[self.i] if self.i < len(self.toks) else None
+
+    def next(self) -> tuple:
+        t = self.peek()
+        if t is None:
+            raise ParseError(self.pos, "unexpected end of line")
+        self.i += 1
+        return t
+
+    def accept(self, kind: str, value=None) -> Optional[tuple]:
+        t = self.peek()
+        if t and t[0] == kind and (value is None or t[1] == value):
+            self.i += 1
+            return t
+        return None
+
+    def expect(self, kind: str, value=None) -> tuple:
+        t = self.accept(kind, value)
+        if t is None:
+            got = self.peek()
+            raise ParseError(
+                self.pos,
+                f"expected {value or kind}, got {got[1] if got else 'EOL'}")
+        return t
+
+    @property
+    def eol(self) -> bool:
+        return self.i >= len(self.toks)
+
+
+def _unescape(s: str) -> str:
+    return s.encode().decode("unicode_escape")
+
+
+def _parse_expr(lx: _Lexer) -> Union[IntLit, Ident]:
+    t = lx.next()
+    if t[0] == "number":
+        return IntLit(t[1], lx.pos)
+    if t[0] == "ident":
+        return Ident(t[1], lx.pos)
+    raise ParseError(lx.pos, f"expected const expression, got {t[1]!r}")
+
+
+def _parse_type(lx: _Lexer) -> TypeExpr:
+    name = lx.expect("ident")[1]
+    te = TypeExpr(name, pos=lx.pos)
+    if lx.accept("punct", "["):
+        while True:
+            te.args.append(_parse_type_arg(lx))
+            if lx.accept("punct", "]"):
+                break
+            lx.expect("punct", ",")
+    if lx.accept("punct", ":"):
+        te.bitfield_len = _parse_expr(lx)
+    return te
+
+
+def _parse_type_arg(lx: _Lexer):
+    t = lx.peek()
+    if t is None:
+        raise ParseError(lx.pos, "unexpected end of type args")
+    if t[0] == "string":
+        lx.next()
+        return StrLit(t[1], lx.pos)
+    if t[0] == "number":
+        lx.next()
+        first: Union[IntLit, Ident] = IntLit(t[1], lx.pos)
+    elif t[0] == "ident":
+        # Could be an ident const, a nested type, or the start of a range.
+        te = _parse_type(lx)
+        if te.args or te.bitfield_len is not None:
+            return te
+        first = Ident(te.name, te.pos)
+    else:
+        raise ParseError(lx.pos, f"bad type argument {t[1]!r}")
+    if lx.accept("punct", ":"):
+        second = _parse_expr(lx)
+        return IntRange(first, second, lx.pos)
+    if isinstance(first, Ident):
+        # A bare ident argument: keep as TypeExpr so the compiler can decide
+        # whether it names a type or a constant.
+        return TypeExpr(first.name, pos=first.pos)
+    return first
+
+
+def _parse_fields_inline(lx: _Lexer, terminator: str) -> List[Field]:
+    fields: List[Field] = []
+    if lx.accept("punct", terminator):
+        return fields
+    while True:
+        fname = lx.expect("ident")[1]
+        ftyp = _parse_type(lx)
+        fields.append(Field(fname, ftyp, lx.pos))
+        if lx.accept("punct", terminator):
+            return fields
+        lx.expect("punct", ",")
+
+
+def parse(text: str, filename: str = "<input>") -> Description:
+    desc = Description()
+    lines = text.split("\n")
+    i = 0
+    while i < len(lines):
+        pos = Pos(filename, i + 1)
+        raw = lines[i]
+        i += 1
+        stripped = raw.split("#", 1)[0].strip() if '"' not in raw else raw.strip()
+        if not stripped or stripped.startswith("#"):
+            continue
+
+        # include / incdir / define are keyword-prefixed raw lines.
+        first_word = stripped.split(None, 1)[0]
+        if first_word in ("include", "incdir"):
+            m = re.match(r"(?:include|incdir)\s*<([^>]*)>", stripped)
+            if not m:
+                raise ParseError(pos, f"malformed {first_word}")
+            desc.nodes.append(IncludeDef(m.group(1), pos))
+            continue
+        if first_word == "define":
+            rest = stripped.split(None, 2)
+            if len(rest) < 3:
+                raise ParseError(pos, "malformed define")
+            desc.nodes.append(DefineDef(rest[1], rest[2].split("#")[0].strip(), pos))
+            continue
+
+        lx = _Lexer(raw, pos)
+        if lx.eol:
+            continue
+
+        if lx.accept("ident", "resource"):
+            name = lx.expect("ident")[1]
+            lx.expect("punct", "[")
+            base = _parse_type(lx)
+            lx.expect("punct", "]")
+            values: List = []
+            if lx.accept("punct", ":"):
+                while True:
+                    values.append(_parse_expr(lx))
+                    if not lx.accept("punct", ","):
+                        break
+            desc.nodes.append(ResourceDef(name, base, values, pos))
+            continue
+
+        name = lx.expect("ident")[1]
+        t = lx.peek()
+
+        if t and t == ("punct", "("):
+            # syscall definition
+            lx.next()
+            fields = _parse_fields_inline(lx, ")")
+            ret = None
+            if not lx.eol:
+                ret = _parse_type(lx)
+            call_name = name.split("$", 1)[0]
+            desc.nodes.append(CallDef(name, call_name, fields, ret, pos))
+            continue
+
+        if t and t == ("punct", "="):
+            # flags or string-flags
+            lx.next()
+            vals: List = []
+            is_str = False
+            while True:
+                tok = lx.next()
+                if tok[0] == "string":
+                    is_str = True
+                    vals.append(tok[1])
+                elif tok[0] == "number":
+                    vals.append(IntLit(tok[1], pos))
+                elif tok[0] == "ident":
+                    vals.append(Ident(tok[1], pos))
+                else:
+                    raise ParseError(pos, f"bad flag value {tok[1]!r}")
+                if not lx.accept("punct", ","):
+                    break
+                # a trailing ',' continues the list on following lines;
+                # skip blank/comment-only continuation lines
+                while lx.eol and i < len(lines):
+                    lx = _Lexer(lines[i], Pos(filename, i + 1))
+                    i += 1
+            if is_str:
+                if any(not isinstance(v, str) for v in vals):
+                    raise ParseError(
+                        pos, f"flag list {name} mixes strings and integers")
+                desc.nodes.append(StrFlagsDef(name, list(vals), pos))
+            else:
+                desc.nodes.append(FlagsDef(name, vals, pos))
+            continue
+
+        if t and (t == ("punct", "{") or t == ("punct", "[")):
+            is_union = t[1] == "["
+            closer = "]" if is_union else "}"
+            lx.next()
+            fields: List[Field] = []
+            attrs: List[str] = []
+            while True:
+                if i >= len(lines):
+                    raise ParseError(pos, f"unterminated {'union' if is_union else 'struct'} {name}")
+                fpos = Pos(filename, i + 1)
+                fline = lines[i]
+                i += 1
+                body = fline.split("#", 1)[0].strip() if '"' not in fline else fline.strip()
+                if not body:
+                    continue
+                flx = _Lexer(fline, fpos)
+                if flx.accept("punct", closer):
+                    # optional attribute list: } [packed, align_4]
+                    if flx.accept("punct", "["):
+                        while True:
+                            attrs.append(flx.expect("ident")[1])
+                            if flx.accept("punct", "]"):
+                                break
+                            flx.expect("punct", ",")
+                    break
+                fname = flx.expect("ident")[1]
+                ftyp = _parse_type(flx)
+                fields.append(Field(fname, ftyp, fpos))
+            desc.nodes.append(StructDef(name, fields, is_union, attrs, pos))
+            continue
+
+        raise ParseError(pos, f"cannot parse line starting with {name!r}")
+
+    return desc
+
+
+def parse_files(paths) -> Description:
+    desc = Description()
+    for p in paths:
+        with open(p) as f:
+            desc.extend(parse(f.read(), str(p)))
+    return desc

@@ -1,0 +1,12 @@
+from .types import (  # noqa: F401
+    ArrayKind, ArrayType, BufferKind, BufferType, ConstType, CsumKind,
+    CsumType, Dir, FlagsType, IntKind, IntType, LenType, ProcType, PtrType,
+    ResourceDesc, ResourceType, StructType, Syscall, TextKind, Type,
+    UnionType, VmaType, foreach_type, is_pad,
+)
+from .prog import (  # noqa: F401
+    Arg, Call, ConstArg, DataArg, GroupArg, PointerArg, Prog, ResultArg,
+    ReturnArg, UnionArg, default_arg, foreach_arg, foreach_subarg,
+    foreach_subarg_offset, inner_arg, make_result_arg,
+)
+from .target import Target, all_targets, get_target, register_target  # noqa: F401

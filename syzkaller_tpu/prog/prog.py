@@ -1,0 +1,435 @@
+"""Host-side program IR: a typed tree of calls and args.
+
+This is the *boundary* representation — used to serialize programs for the
+executor, to parse/persist the corpus, and for minimization. The fuzzing hot
+path does not walk these trees; it operates on the fixed-width tensor encoding
+in `syzkaller_tpu.prog.tensor` (batched on TPU). Capability parity with
+reference /root/reference/prog/prog.go:10-382 (arg kinds, cross-call result
+dataflow with use-edges, tree surgery).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+from .types import (
+    ArrayType,
+    BufferKind,
+    BufferType,
+    ConstType,
+    CsumType,
+    Dir,
+    FlagsType,
+    IntType,
+    LenType,
+    ProcType,
+    PtrType,
+    ResourceType,
+    StructType,
+    Syscall,
+    Type,
+    UINT64_MAX,
+    UnionType,
+    VmaType,
+)
+
+
+def _swap(value: int, size: int) -> int:
+    return int.from_bytes(value.to_bytes(size, "little"), "big")
+
+
+def encode_value(value: int, size: int, big_endian: bool) -> int:
+    value &= UINT64_MAX
+    if not big_endian:
+        return value
+    if size not in (2, 4, 8):
+        raise ValueError(f"bad size {size} for big-endian value")
+    return _swap(value & ((1 << (8 * size)) - 1), size)
+
+
+class Arg:
+    """Base of the argument tree."""
+
+    __slots__ = ("typ",)
+
+    def __init__(self, typ: Type):
+        self.typ = typ
+
+    def size(self) -> int:
+        return self.typ.size
+
+
+class ConstArg(Arg):
+    """Value of an int-like type (const/int/flags/len/proc/csum)."""
+
+    __slots__ = ("val",)
+
+    def __init__(self, typ: Type, val: int):
+        super().__init__(typ)
+        self.val = val & UINT64_MAX
+
+    def value(self, pid: int = 0) -> int:
+        """Wire value: endianness- and executor-pid-adjusted."""
+        t = self.typ
+        if isinstance(t, CsumType):
+            return 0  # computed dynamically by the executor
+        if isinstance(t, ProcType):
+            v = t.values_start + t.values_per_proc * pid + self.val
+            return encode_value(v, t.size, t.big_endian)
+        if isinstance(t, ResourceType):
+            base = t.desc.typ
+            return encode_value(self.val, base.size, getattr(base, "big_endian", False))
+        big = getattr(t, "big_endian", False)
+        return encode_value(self.val, t.size, big)
+
+
+class PointerArg(Arg):
+    """Pointer in abstract page+offset form (used for PtrType and VmaType)."""
+
+    __slots__ = ("page_index", "page_offset", "pages_num", "res")
+
+    def __init__(self, typ: Type, page_index: int = 0, page_offset: int = 0,
+                 pages_num: int = 0, res: Optional[Arg] = None):
+        super().__init__(typ)
+        self.page_index = page_index
+        self.page_offset = page_offset  # may be negative: offset from page end
+        self.pages_num = pages_num
+        self.res = res  # pointee
+
+
+class DataArg(Arg):
+    """Byte payload of a BufferType."""
+
+    __slots__ = ("data",)
+
+    def __init__(self, typ: Type, data: bytes = b""):
+        super().__init__(typ)
+        self.data = bytes(data)
+
+    def size(self) -> int:
+        return len(self.data)
+
+
+class GroupArg(Arg):
+    """Struct or array contents."""
+
+    __slots__ = ("inner",)
+
+    def __init__(self, typ: Type, inner: Optional[List[Arg]] = None):
+        super().__init__(typ)
+        self.inner: List[Arg] = inner if inner is not None else []
+
+    def size(self) -> int:
+        t = self.typ
+        if not t.is_varlen:
+            return t.size
+        if isinstance(t, StructType):
+            sz = sum(f.size() for f in self.inner if not f.typ.bitfield_middle)
+            if t.align_attr and sz % t.align_attr:
+                sz += t.align_attr - sz % t.align_attr
+            return sz
+        if isinstance(t, ArrayType):
+            return sum(e.size() for e in self.inner)
+        raise TypeError(f"bad group arg type {t}")
+
+
+class UnionArg(Arg):
+    __slots__ = ("option", "option_type")
+
+    def __init__(self, typ: Type, option: Arg, option_type: Type):
+        super().__init__(typ)
+        self.option = option
+        self.option_type = option_type
+
+    def size(self) -> int:
+        if not self.typ.is_varlen:
+            return self.typ.size
+        return self.option.size()
+
+
+class ResultArg(Arg):
+    """Resource value: either a constant or a reference to a producing arg
+    (cross-call dataflow). `uses` is the reverse edge set."""
+
+    __slots__ = ("res", "op_div", "op_add", "val", "uses")
+
+    def __init__(self, typ: Type, res: Optional[Arg] = None, val: int = 0,
+                 op_div: int = 0, op_add: int = 0):
+        super().__init__(typ)
+        self.res = res
+        self.op_div = op_div
+        self.op_add = op_add
+        self.val = val & UINT64_MAX
+        self.uses: set = set()
+
+
+class ReturnArg(Arg):
+    """Denotes the syscall return value slot."""
+
+    __slots__ = ("uses",)
+
+    def __init__(self, typ: Optional[Type]):
+        super().__init__(typ)
+        self.uses: set = set()
+
+    def size(self) -> int:
+        raise RuntimeError("size() of a return arg")
+
+
+def make_result_arg(typ: Type, res: Optional[Arg], val: int = 0) -> ResultArg:
+    arg = ResultArg(typ, res=res, val=val)
+    if res is not None:
+        assert isinstance(res, (ResultArg, ReturnArg))
+        res.uses.add(arg)
+    return arg
+
+
+def default_arg(t: Type) -> Arg:
+    """The canonical simplest value of a type (used by minimization and to
+    patch dangling result references)."""
+    if isinstance(t, (IntType, ConstType, FlagsType, LenType, ProcType, CsumType)):
+        return ConstArg(t, t.default())
+    if isinstance(t, ResourceType):
+        return make_result_arg(t, None, t.desc.typ.default())
+    if isinstance(t, BufferType):
+        # Fixed-size buffers must occupy their static size or sibling field
+        # offsets diverge from the compiled layout.
+        data = b"\x00" * t.size if t.size != 0 else b""
+        return DataArg(t, data)
+    if isinstance(t, ArrayType):
+        return GroupArg(t, [])
+    if isinstance(t, StructType):
+        return GroupArg(t, [default_arg(f) for f in t.fields])
+    if isinstance(t, UnionType):
+        return UnionArg(t, default_arg(t.fields[0]), t.fields[0])
+    if isinstance(t, VmaType):
+        return PointerArg(t, 0, 0, 1, None)
+    if isinstance(t, PtrType):
+        res = None
+        if not t.optional and t.dir != Dir.OUT:
+            res = default_arg(t.elem)
+        return PointerArg(t, 0, 0, 0, res)
+    raise TypeError(f"unknown type {t}")
+
+
+def inner_arg(arg: Arg) -> Optional[Arg]:
+    """Dereference pointer args down to the pointee."""
+    if isinstance(arg.typ, PtrType):
+        if isinstance(arg, PointerArg):
+            if arg.res is None:
+                return None
+            return inner_arg(arg.res)
+        return None
+    return arg
+
+
+@dataclass
+class Call:
+    meta: Syscall
+    args: List[Arg] = field(default_factory=list)
+    ret: Optional[ReturnArg] = None
+
+
+def foreach_subarg(arg: Arg, fn: Callable[[Arg, Optional[Arg]], None],
+                   base: Optional[Arg] = None) -> None:
+    """Depth-first traversal of an arg subtree. `fn(arg, base)` where base is
+    the innermost enclosing pointer arg (None at top level)."""
+    fn(arg, base)
+    if isinstance(arg, GroupArg):
+        for a in list(arg.inner):
+            foreach_subarg(a, fn, base)
+    elif isinstance(arg, PointerArg):
+        if arg.res is not None:
+            foreach_subarg(arg.res, fn, arg)
+    elif isinstance(arg, UnionArg):
+        foreach_subarg(arg.option, fn, base)
+
+
+def foreach_arg(call: Call, fn: Callable[[Arg, Optional[Arg]], None]) -> None:
+    for a in list(call.args):
+        foreach_subarg(a, fn)
+
+
+def foreach_subarg_offset(arg: Arg, fn: Callable[[Arg, int], None]) -> None:
+    """Traverse a pointee subtree with byte offsets of each sub-arg from the
+    start of `arg` (mirrors copyin layout; reference prog/analysis.go)."""
+
+    def rec(a: Arg, offset: int) -> int:
+        fn(a, offset)
+        if isinstance(a, GroupArg):
+            if isinstance(a.typ, StructType):
+                for f in a.inner:
+                    rec(f, offset)
+                    if not f.typ.bitfield_middle:
+                        offset += f.size()
+                # note: trailing align padding is part of struct size only
+            else:  # array
+                for e in a.inner:
+                    offset = rec(e, offset)
+            return offset
+        if isinstance(a, UnionArg):
+            rec(a.option, offset)
+            return offset + a.size()
+        if isinstance(a, ReturnArg):
+            return offset
+        return offset + a.size()
+
+    rec(arg, 0)
+
+
+class Prog:
+    """A syscall program: an ordered list of calls with cross-call dataflow."""
+
+    def __init__(self, target, calls: Optional[List[Call]] = None):
+        self.target = target
+        self.calls: List[Call] = calls if calls is not None else []
+
+    # ---- tree surgery (used by mutation/minimize on the host side) ----
+
+    def insert_before(self, c: Optional[Call], calls: List[Call]) -> None:
+        if not calls:
+            return
+        idx = len(self.calls)
+        if c is not None:
+            for i, cc in enumerate(self.calls):
+                if cc is c:
+                    idx = i
+                    break
+        self.calls[idx:idx] = calls
+
+    def replace_arg(self, c: Call, arg: Arg, arg1: Arg, calls: List[Call]) -> None:
+        for cc in calls:
+            self.target.sanitize_call(cc)
+        self.insert_before(c, calls)
+        if isinstance(arg, ConstArg):
+            arg.val = arg1.val
+            arg.typ = arg1.typ
+        elif isinstance(arg, ResultArg):
+            if arg.res is not None:
+                arg.res.uses.discard(arg)
+            arg.res, arg.op_div, arg.op_add, arg.val = (
+                arg1.res, arg1.op_div, arg1.op_add, arg1.val)
+            arg.typ = arg1.typ
+            if arg.res is not None:
+                arg.res.uses.discard(arg1)
+                arg.res.uses.add(arg)
+        elif isinstance(arg, PointerArg):
+            arg.page_index = arg1.page_index
+            arg.page_offset = arg1.page_offset
+            arg.pages_num = arg1.pages_num
+            arg.res = arg1.res
+            arg.typ = arg1.typ
+        elif isinstance(arg, UnionArg):
+            arg.option = arg1.option
+            arg.option_type = arg1.option_type
+        elif isinstance(arg, DataArg):
+            arg.data = arg1.data
+        else:
+            raise TypeError(f"replace_arg: bad arg kind {arg}")
+        self.target.sanitize_call(c)
+
+    def _owning_call(self, arg: Arg) -> Optional[Call]:
+        for c in self.calls:
+            found = [False]
+
+            def chk(a: Arg, _b):
+                if a is arg:
+                    found[0] = True
+
+            for top in c.args:
+                foreach_subarg(top, chk)
+            if c.ret is arg:
+                found[0] = True
+            if found[0]:
+                return c
+        return None
+
+    def remove_arg(self, c: Call, arg0: Optional[Arg]) -> None:
+        """Remove all dataflow edges to/from arg0's subtree; dangling consumers
+        are rewritten to default constant resources."""
+        if arg0 is None:
+            return
+
+        def visit(arg: Arg, _base):
+            if isinstance(arg, ResultArg) and arg.res is not None:
+                arg.res.uses.discard(arg)
+            if isinstance(arg, (ResultArg, ReturnArg)):
+                for user in list(arg.uses):
+                    repl = make_result_arg(user.typ, None, user.typ.default())
+                    # The dangling consumer lives in a *later* call, not in
+                    # the call being removed — re-sanitize that call.
+                    uc = self._owning_call(user) or c
+                    self.replace_arg(uc, user, repl, [])
+
+        foreach_subarg(arg0, visit)
+
+    def remove_call(self, idx: int) -> None:
+        c = self.calls.pop(idx)
+        for arg in c.args:
+            self.remove_arg(c, arg)
+        self.remove_arg(c, c.ret)
+
+    def clone(self) -> "Prog":
+        """Deep copy preserving result-arg links."""
+        mapping: dict = {}
+
+        def copy_arg(arg: Optional[Arg]) -> Optional[Arg]:
+            if arg is None:
+                return None
+            if isinstance(arg, ConstArg):
+                new = ConstArg(arg.typ, arg.val)
+            elif isinstance(arg, PointerArg):
+                new = PointerArg(arg.typ, arg.page_index, arg.page_offset,
+                                 arg.pages_num, copy_arg(arg.res))
+            elif isinstance(arg, DataArg):
+                new = DataArg(arg.typ, arg.data)
+            elif isinstance(arg, GroupArg):
+                new = GroupArg(arg.typ, [copy_arg(a) for a in arg.inner])
+            elif isinstance(arg, UnionArg):
+                new = UnionArg(arg.typ, copy_arg(arg.option), arg.option_type)
+            elif isinstance(arg, ResultArg):
+                res = mapping.get(id(arg.res)) if arg.res is not None else None
+                new = ResultArg(arg.typ, res=res, val=arg.val,
+                                op_div=arg.op_div, op_add=arg.op_add)
+                if res is not None:
+                    res.uses.add(new)
+            elif isinstance(arg, ReturnArg):
+                new = ReturnArg(arg.typ)
+            else:
+                raise TypeError(f"clone: bad arg {arg}")
+            mapping[id(arg)] = new
+            return new
+
+        calls = []
+        for c in self.calls:
+            nc = Call(meta=c.meta, args=[copy_arg(a) for a in c.args],
+                      ret=copy_arg(c.ret))
+            calls.append(nc)
+        return Prog(self.target, calls)
+
+    def validate(self) -> None:
+        """Structural invariants: use-edges symmetric, result refs point to
+        args of earlier-or-same calls."""
+        seen: set = set()
+        for c in self.calls:
+            for a in c.args:
+                foreach_subarg(a, lambda arg, _b: seen.add(id(arg)))
+            if c.ret is not None:
+                seen.add(id(c.ret))
+        for c in self.calls:
+            def check(arg: Arg, _base):
+                if isinstance(arg, ResultArg) and arg.res is not None:
+                    if id(arg.res) not in seen:
+                        raise AssertionError(
+                            f"result arg references a detached arg in {c.meta.name}")
+                    if arg not in arg.res.uses:
+                        raise AssertionError("use edge missing")
+                if isinstance(arg, (ResultArg, ReturnArg)):
+                    for u in arg.uses:
+                        if u.res is not arg:
+                            raise AssertionError("reverse use edge broken")
+            for a in c.args:
+                foreach_subarg(a, check)
+            if c.ret is not None:
+                check(c.ret, None)

@@ -1,0 +1,169 @@
+"""Target: one OS/arch pair — its syscall surface, resources, and arch hooks.
+
+Capability parity with reference /root/reference/prog/target.go:12-148 and
+/root/reference/prog/resources.go (ctor discovery, resource compatibility
+lattice, transitively-enabled-call fixpoint). The compiled numpy tables the
+TPU kernels consume are derived from this object by
+`syzkaller_tpu.descriptions.tables.compile_tables`.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from .types import (
+    Dir,
+    ResourceDesc,
+    ResourceType,
+    StructType,
+    Syscall,
+    Type,
+    foreach_type,
+)
+
+
+def is_compatible_resource_kinds(dst: Sequence[str], src: Sequence[str],
+                                 precise: bool = False) -> bool:
+    """True if a resource of kind chain `src` can be passed where `dst` is
+    expected. Kind chains are most-general-first (e.g. ("fd", "sock")).
+    Imprecise mode allows passing a less specialized resource (fd as sock)."""
+    if len(dst) > len(src):
+        if precise:
+            return False
+        dst = dst[: len(src)]
+    if len(src) > len(dst):
+        src = src[: len(dst)]
+    return all(d == s for d, s in zip(dst, src))
+
+
+class Target:
+    def __init__(self, os: str, arch: str, *, ptr_size: int = 8,
+                 page_size: int = 4096, data_offset: int = 0x10000000,
+                 num_pages: int = 4096, revision: str = "",
+                 syscalls: Optional[List[Syscall]] = None,
+                 resources: Optional[List[ResourceDesc]] = None,
+                 consts: Optional[Dict[str, int]] = None):
+        self.os = os
+        self.arch = arch
+        self.revision = revision
+        self.ptr_size = ptr_size
+        self.page_size = page_size
+        self.data_offset = data_offset
+        self.num_pages = num_pages  # size of the data arena in pages
+
+        self.syscalls: List[Syscall] = syscalls or []
+        self.resources: List[ResourceDesc] = resources or []
+        self.consts: Dict[str, int] = dict(consts or {})
+
+        self.syscall_map: Dict[str, Syscall] = {c.name: c for c in self.syscalls}
+        self.resource_map: Dict[str, ResourceDesc] = {r.name: r for r in self.resources}
+        # resource name -> calls that can create it (imprecise match)
+        self.resource_ctors: Dict[str, List[Syscall]] = {
+            r.name: self.calc_resource_ctors(r.kind, precise=False)
+            for r in self.resources
+        }
+
+        # --- arch hooks, overridable by OS modules ---
+        self.mmap_syscall: Optional[Syscall] = None
+        self.make_mmap: Callable[[int, int], object] = self._no_mmap
+        self.analyze_mmap: Callable[[object], Tuple[int, int, bool]] = (
+            lambda c: (0, 0, False))
+        self.sanitize_call: Callable[[object], None] = lambda c: None
+        self.special_structs: Dict[str, Callable] = {}
+        self.string_dictionary: List[str] = []
+
+    def _no_mmap(self, start: int, npages: int):
+        raise RuntimeError(f"target {self.os}/{self.arch} has no mmap hook")
+
+    # ---- resources ----
+
+    def calc_resource_ctors(self, kind: Sequence[str],
+                            precise: bool) -> List[Syscall]:
+        """Calls with an out/inout resource arg compatible with `kind`."""
+        metas = []
+        for meta in self.syscalls:
+            found = [False]
+
+            def visit(t: Type):
+                if found[0]:
+                    return
+                if isinstance(t, ResourceType) and t.dir != Dir.IN:
+                    if is_compatible_resource_kinds(tuple(kind), t.desc.kind,
+                                                   precise):
+                        found[0] = True
+
+            foreach_type(meta, visit)
+            if found[0]:
+                metas.append(meta)
+        return metas
+
+    def is_compatible_resource(self, dst: str, src: str) -> bool:
+        return is_compatible_resource_kinds(
+            self.resource_map[dst].kind, self.resource_map[src].kind)
+
+    @staticmethod
+    def input_resources(meta: Syscall) -> List[ResourceType]:
+        res: List[ResourceType] = []
+
+        def visit(t: Type):
+            if isinstance(t, ResourceType) and t.dir != Dir.OUT and not t.optional:
+                res.append(t)
+
+        foreach_type(meta, visit)
+        return res
+
+    def transitively_enabled_calls(
+            self, enabled: Sequence[Syscall]) -> List[Syscall]:
+        """Fixpoint-prune calls whose required input resources cannot be
+        constructed by any other enabled call (precise ctor match)."""
+        supported = {c.name: c for c in enabled}
+        inputs = {c.name: self.input_resources(c) for c in enabled}
+        ctors = {}
+        for c in enabled:
+            for r in inputs[c.name]:
+                if r.desc.name not in ctors:
+                    ctors[r.desc.name] = self.calc_resource_ctors(
+                        r.desc.kind, precise=True)
+        while True:
+            n = len(supported)
+            for name in list(supported):
+                ok = True
+                for r in inputs[name]:
+                    if not any(ct.name in supported for ct in ctors[r.desc.name]):
+                        ok = False
+                        break
+                if not ok:
+                    del supported[name]
+            if n == len(supported):
+                break
+        return [c for c in self.syscalls if c.name in supported]
+
+
+_targets: Dict[str, Target] = {}
+
+
+def register_target(target: Target,
+                    init_arch: Optional[Callable[[Target], None]] = None) -> None:
+    key = f"{target.os}/{target.arch}"
+    if key in _targets:
+        raise ValueError(f"duplicate target {key}")
+    if init_arch is not None:
+        init_arch(target)
+    _targets[key] = target
+
+
+def get_target(os: str, arch: str) -> Target:
+    key = f"{os}/{arch}"
+    if key not in _targets:
+        # Lazily build the bundled linux target from its descriptions.
+        if os == "linux":
+            from ..descriptions import linux as _linux  # noqa: F401
+            _linux.ensure_registered(arch)
+        if key not in _targets:
+            raise KeyError(
+                f"unknown target {key} (known: {sorted(_targets)})")
+    return _targets[key]
+
+
+def all_targets() -> List[Target]:
+    return [_targets[k] for k in sorted(_targets)]

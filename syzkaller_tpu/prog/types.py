@@ -1,0 +1,258 @@
+"""Typed syscall model: the type lattice that drives generation and mutation.
+
+Capability parity with the reference type system (reference:
+/root/reference/prog/types.go:10-340) — resources, consts, ints, flags,
+lens, procs, checksums, vmas, buffers (blob/string/filename/text), arrays,
+pointers, structs/unions, bitfields, endianness — but expressed as frozen
+Python dataclasses that compile down to flat numpy tables
+(`syzkaller_tpu.descriptions.tables`) which the JAX kernels index, instead
+of being walked as trees on the hot path.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field, replace
+from typing import Optional, Tuple
+
+UINT64_MAX = (1 << 64) - 1
+
+
+class Dir(enum.IntEnum):
+    IN = 0
+    OUT = 1
+    INOUT = 2
+
+
+class IntKind(enum.IntEnum):
+    PLAIN = 0
+    FILEOFF = 1  # offset within a file
+    RANGE = 2
+
+
+class BufferKind(enum.IntEnum):
+    BLOB_RAND = 0
+    BLOB_RANGE = 1
+    STRING = 2
+    FILENAME = 3
+    TEXT = 4  # machine code
+
+
+class TextKind(enum.IntEnum):
+    X86_REAL = 0
+    X86_16 = 1
+    X86_32 = 2
+    X86_64 = 3
+    ARM64 = 4
+
+
+class ArrayKind(enum.IntEnum):
+    RAND_LEN = 0
+    RANGE_LEN = 1
+
+
+class CsumKind(enum.IntEnum):
+    INET = 0
+    PSEUDO = 1
+
+
+@dataclass(frozen=True)
+class Type:
+    """Common base. ``size == 0`` means variable-length."""
+
+    name: str = ""
+    field_name: str = ""
+    size: int = 0
+    dir: Dir = Dir.IN
+    optional: bool = False
+
+    @property
+    def is_varlen(self) -> bool:
+        return self.size == 0
+
+    def default(self) -> int:
+        return 0
+
+    # Bitfield interface; only int-like types override.
+    @property
+    def bitfield_offset(self) -> int:
+        return 0
+
+    @property
+    def bitfield_length(self) -> int:
+        return 0
+
+    @property
+    def bitfield_middle(self) -> bool:
+        """True for all but the last bitfield in a group (occupies 0 bytes)."""
+        return False
+
+    def with_dir(self, d: Dir) -> "Type":
+        return replace(self, dir=d)
+
+    def with_field(self, fname: str) -> "Type":
+        return replace(self, field_name=fname)
+
+
+@dataclass(frozen=True)
+class IntCommon(Type):
+    bitfield_off: int = 0
+    bitfield_len: int = 0
+    big_endian: bool = False
+    bitfield_mdl: bool = False
+
+    @property
+    def bitfield_offset(self) -> int:
+        return self.bitfield_off
+
+    @property
+    def bitfield_length(self) -> int:
+        return self.bitfield_len
+
+    @property
+    def bitfield_middle(self) -> bool:
+        return self.bitfield_mdl
+
+
+@dataclass(frozen=True)
+class ResourceDesc:
+    name: str
+    typ: "Type" = None  # underlying int type
+    kind: Tuple[str, ...] = ()  # compatibility chain, most-general first
+    values: Tuple[int, ...] = (0,)  # special (reset) values
+
+
+@dataclass(frozen=True)
+class ResourceType(Type):
+    desc: ResourceDesc = None
+
+    def default(self) -> int:
+        return self.desc.values[0]
+
+    @property
+    def special_values(self) -> Tuple[int, ...]:
+        return self.desc.values
+
+
+@dataclass(frozen=True)
+class ConstType(IntCommon):
+    val: int = 0
+    is_pad: bool = False
+
+    def default(self) -> int:
+        return self.val
+
+
+@dataclass(frozen=True)
+class IntType(IntCommon):
+    kind: IntKind = IntKind.PLAIN
+    range_begin: int = 0
+    range_end: int = 0
+
+
+@dataclass(frozen=True)
+class FlagsType(IntCommon):
+    vals: Tuple[int, ...] = ()
+
+
+@dataclass(frozen=True)
+class LenType(IntCommon):
+    buf: str = ""  # name of the sized sibling field
+    byte_size: int = 0  # 0: count elements; N: size in N-byte units
+
+
+@dataclass(frozen=True)
+class ProcType(IntCommon):
+    """Per-process disjoint value ranges (ids that must not collide across
+    parallel executor processes)."""
+
+    values_start: int = 0
+    values_per_proc: int = 1
+
+    def default(self) -> int:
+        return self.values_start
+
+
+@dataclass(frozen=True)
+class CsumType(IntCommon):
+    kind: CsumKind = CsumKind.INET
+    buf: str = ""
+    protocol: int = 0  # for PSEUDO
+
+
+@dataclass(frozen=True)
+class VmaType(Type):
+    range_begin: int = 0  # in pages
+    range_end: int = 0
+
+
+@dataclass(frozen=True)
+class BufferType(Type):
+    kind: BufferKind = BufferKind.BLOB_RAND
+    range_begin: int = 0
+    range_end: int = 0
+    text: TextKind = TextKind.X86_64
+    sub_kind: str = ""
+    values: Tuple[str, ...] = ()  # possible values for STRING kind
+
+
+@dataclass(frozen=True)
+class ArrayType(Type):
+    elem: Type = None
+    kind: ArrayKind = ArrayKind.RAND_LEN
+    range_begin: int = 0
+    range_end: int = 0
+
+
+@dataclass(frozen=True)
+class PtrType(Type):
+    elem: Type = None
+
+
+@dataclass(frozen=True)
+class StructType(Type):
+    fields: Tuple[Type, ...] = ()
+    align_attr: int = 0
+    packed: bool = False
+
+
+@dataclass(frozen=True)
+class UnionType(Type):
+    fields: Tuple[Type, ...] = ()
+
+
+@dataclass(frozen=True)
+class Syscall:
+    id: int  # dense index into Target.syscalls
+    nr: int  # kernel syscall number
+    name: str  # full variant name, e.g. "open$generic"
+    call_name: str  # base name, e.g. "open"
+    args: Tuple[Type, ...] = ()
+    ret: Optional[Type] = None
+
+
+def is_pad(t: Type) -> bool:
+    return isinstance(t, ConstType) and t.is_pad
+
+
+def foreach_type(call: Syscall, fn) -> None:
+    """Visit every type reachable from a syscall signature, pruning cycles
+    through struct/union names (descriptions may be recursive via pointers)."""
+    seen = set()
+
+    def rec(t: Type):
+        fn(t)
+        if isinstance(t, (PtrType, ArrayType)):
+            rec(t.elem)
+        elif isinstance(t, (StructType, UnionType)):
+            key = (t.name, t.dir, type(t).__name__)
+            if key in seen:
+                return
+            seen.add(key)
+            for f in t.fields:
+                rec(f)
+
+    for a in call.args:
+        rec(a)
+    if call.ret is not None:
+        rec(call.ret)

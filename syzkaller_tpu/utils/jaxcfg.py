@@ -1,0 +1,21 @@
+"""JAX configuration shared by all device modules.
+
+Program words are u64 and coverage signal is u32; every module that touches
+jax must call ensure_x64() before building arrays so 64-bit integer lanes are
+enabled process-wide (on TPU, XLA lowers u64 bitwise ops to u32 pairs — fine
+for the bitset/mutation workloads here).
+"""
+
+from __future__ import annotations
+
+_done = False
+
+
+def ensure_x64() -> None:
+    global _done
+    if _done:
+        return
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    _done = True

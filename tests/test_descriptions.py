@@ -1,0 +1,126 @@
+"""Description pipeline tests: parser, compiler, target invariants.
+
+Mirrors the reference's compiler-test strategy (fixture descriptions +
+structural checks; reference: /root/reference/pkg/compiler/compiler_test.go)
+but asserts on the live Target instead of golden generated files.
+"""
+
+import pytest
+
+from syzkaller_tpu.descriptions.compiler import Compiler, compile_description
+from syzkaller_tpu.descriptions.parser import ParseError, parse
+from syzkaller_tpu.prog import (
+    BufferKind, BufferType, ConstType, Dir, FlagsType, IntType, LenType,
+    PtrType, ResourceType, StructType, UnionType,
+)
+from syzkaller_tpu.prog.target import get_target
+
+
+def test_parse_basic():
+    d = parse(
+        """
+# comment
+resource fd[int32]: -1, AT_FDCWD
+open_flags = O_A, O_B, 0x4
+names = "a", "bb"
+foo(a fd, b ptr[in, bar], c flags[open_flags]) fd
+bar {
+\tf1\tint32
+\tf2\tint8:3
+\tf3\tint8:5
+}
+baz [
+\topt1\tint64
+\topt2\tarray[int8, 8]
+]
+"""
+    )
+    kinds = [type(n).__name__ for n in d.nodes]
+    assert kinds == ["ResourceDef", "FlagsDef", "StrFlagsDef", "CallDef",
+                     "StructDef", "StructDef"]
+
+
+def test_parse_error():
+    with pytest.raises(ParseError):
+        parse("foo(a b c)")
+
+
+def _mini_target():
+    d = parse(
+        """
+resource fd[int32]: -1
+
+open(file ptr[in, filename], flags flags[oflags]) fd
+close(fd fd)
+read(fd fd, buf buffer[out], n len[buf])
+use_s(s ptr[in, s_t])
+
+s_t {
+\ta\tint32
+\tb\tint8
+\tc\tint64
+\td\tint16be
+\te\tarray[int8, 3]
+}
+
+oflags = O_X, O_Y
+"""
+    )
+    return compile_description(
+        d, {"__NR_open": 2, "__NR_close": 3, "__NR_read": 0,
+            "__NR_use_s": 99, "O_X": 1, "O_Y": 2})
+
+
+def test_compile_mini():
+    t = _mini_target()
+    assert [c.name for c in t.syscalls] == ["open", "close", "read", "use_s"]
+    o = t.syscall_map["open"]
+    assert isinstance(o.args[0], PtrType)
+    assert isinstance(o.args[0].elem, BufferType)
+    assert o.args[0].elem.kind == BufferKind.FILENAME
+    assert isinstance(o.ret, ResourceType)
+    assert o.ret.dir == Dir.OUT
+    r = t.syscall_map["read"]
+    assert isinstance(r.args[2], LenType) and r.args[2].buf == "buf"
+    # layout: a(4) b(1) pad(1) d-align... a=0,b=4,pad,e...
+    s = t.syscall_map["use_s"].args[0].elem
+    assert isinstance(s, StructType)
+    sizes = [(f.field_name, f.size) for f in s.fields]
+    # a:4 b:1 pad:3 c:8 d:2 e:3 pad:3 -> 24 total, align 8
+    assert s.size == 24, sizes
+
+
+def test_linux_target_loads():
+    t = get_target("linux", "amd64")
+    assert len(t.syscalls) > 150
+    assert "open" in t.syscall_map
+    assert t.syscall_map["open"].nr == 2
+    assert t.mmap_syscall is not None
+    # every resource has at least one ctor or is a root (uid/gid via getuid)
+    assert t.resource_ctors["fd"], "fd must have constructors"
+    # all calls remain enabled under transitive closure
+    assert len(t.transitively_enabled_calls(t.syscalls)) == len(t.syscalls)
+
+
+def test_linux_resource_compat():
+    t = get_target("linux", "amd64")
+    assert t.is_compatible_resource("fd", "sock")
+    assert t.is_compatible_resource("sock", "fd")  # imprecise direction
+    assert not t.is_compatible_resource("sock_tcp", "sock_udp")
+
+
+def test_mmap_hook():
+    t = get_target("linux", "amd64")
+    c = t.make_mmap(3, 2)
+    assert c.meta.name == "mmap"
+    assert c.args[0].page_index == 3 and c.args[0].pages_num == 2
+    start, npages, mapped = t.analyze_mmap(c)
+    assert (start, npages, mapped) == (3, 2, True)
+
+
+def test_sanitize_mmap_forces_fixed():
+    t = get_target("linux", "amd64")
+    c = t.make_mmap(0, 1)
+    c.args[3].val = 0
+    t.sanitize_call(c)
+    assert c.args[3].val & t.consts["MAP_FIXED"]
