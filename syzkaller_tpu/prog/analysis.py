@@ -1,0 +1,156 @@
+"""Conservative program state analysis + len-field assignment.
+
+State replay tracks which resources/files/strings/pages are live at a point
+in the program (drives generation and resource reuse); assign_sizes recomputes
+LenType args after mutation. Capability parity with reference
+/root/reference/prog/analysis.go:15-170 and /root/reference/prog/size.go.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .prog import (
+    Arg,
+    Call,
+    ConstArg,
+    DataArg,
+    GroupArg,
+    PointerArg,
+    Prog,
+    foreach_subarg,
+    inner_arg,
+)
+from .types import (
+    ArrayType,
+    BufferKind,
+    BufferType,
+    Dir,
+    LenType,
+    ResourceType,
+    StructType,
+    VmaType,
+    is_pad,
+)
+
+
+class State:
+    """What is [potentially] live after executing a prefix of a program."""
+
+    def __init__(self, target, ct=None):
+        self.target = target
+        self.ct = ct  # choice table (may be None)
+        self.files: Dict[str, bool] = {}
+        self.resources: Dict[str, List[Arg]] = {}
+        self.strings: Dict[str, bool] = {}
+        self.pages = [False] * target.num_pages
+
+    def analyze(self, c: Call) -> None:
+        def visit(arg: Arg, _base):
+            t = arg.typ
+            if isinstance(t, ResourceType):
+                if t.dir != Dir.IN:
+                    self.resources.setdefault(t.desc.name, []).append(arg)
+            elif isinstance(t, BufferType) and isinstance(arg, DataArg):
+                if t.dir != Dir.OUT and arg.data:
+                    if t.kind == BufferKind.STRING:
+                        self.strings[arg.data.decode("latin1")] = True
+                    elif t.kind == BufferKind.FILENAME:
+                        self.files[arg.data.decode("latin1")] = True
+
+        for a in c.args:
+            foreach_subarg(a, visit)
+        if c.ret is not None:
+            visit(c.ret, None)
+
+        start, npages, mapped = self.target.analyze_mmap(c)
+        if npages:
+            end = min(start + npages, len(self.pages))
+            for i in range(start, end):
+                self.pages[i] = mapped
+
+
+def analyze(ct, p: Prog, c: Optional[Call]) -> State:
+    """State up to but not including call c (or the whole program)."""
+    s = State(p.target, ct)
+    for c1 in p.calls:
+        if c1 is c:
+            break
+        s.analyze(c1)
+    return s
+
+
+# ---------------------------------------------------------------------- #
+# Len-field assignment
+
+
+def _generate_size(target, arg: Optional[Arg], len_type: LenType) -> int:
+    if arg is None:
+        return 0  # optional pointer
+    t = arg.typ
+    if isinstance(t, VmaType):
+        return arg.pages_num * target.page_size
+    if isinstance(t, ArrayType) and isinstance(arg, GroupArg):
+        if len_type.byte_size:
+            return arg.size() // len_type.byte_size
+        return len(arg.inner)
+    if len_type.byte_size:
+        return arg.size() // len_type.byte_size
+    return arg.size()
+
+
+def _assign_sizes(target, args: List[Arg], parents: Dict[int, Arg]) -> None:
+    by_field = {a.typ.field_name: a for a in args if not is_pad(a.typ)}
+    for arg in args:
+        arg = inner_arg(arg)
+        if arg is None:
+            continue
+        t = arg.typ
+        if not isinstance(t, LenType) or not isinstance(arg, ConstArg):
+            continue
+        buf = by_field.get(t.buf)
+        if buf is not None:
+            arg.val = _generate_size(target, inner_arg(buf), t)
+            continue
+        if t.buf == "parent":
+            parent = parents.get(id(arg))
+            if parent is not None:
+                v = parent.size()
+                arg.val = v // t.byte_size if t.byte_size else v
+            continue
+        # path to a named ancestor struct
+        parent = parents.get(id(arg))
+        assigned = False
+        while parent is not None:
+            if t.buf == parent.typ.name:
+                v = parent.size()
+                arg.val = v // t.byte_size if t.byte_size else v
+                assigned = True
+                break
+            parent = parents.get(id(parent))
+        if not assigned:
+            raise ValueError(
+                f"len field {t.field_name!r} references unknown field {t.buf!r}")
+
+
+def assign_sizes_call(target, c: Call) -> None:
+    parents: Dict[int, Arg] = {}
+
+    def collect(arg: Arg, _base):
+        if isinstance(arg.typ, StructType) and isinstance(arg, GroupArg):
+            for f in arg.inner:
+                fi = inner_arg(f)
+                if fi is not None:
+                    parents[id(fi)] = arg
+
+    for a in c.args:
+        foreach_subarg(a, collect)
+
+    _assign_sizes(target, c.args, parents)
+
+    def fix_structs(arg: Arg, _base):
+        if isinstance(arg.typ, StructType) and isinstance(arg, GroupArg):
+            _assign_sizes(target, arg.inner, parents)
+
+    for a in c.args:
+        foreach_subarg(a, fix_structs)

@@ -1,0 +1,380 @@
+"""Human-readable program serialization (corpus / logs / repro format).
+
+Plays the role of the reference's text format (reference:
+/root/reference/prog/encoding.go:16-580): round-trippable, one call per
+line, resources named rN. The grammar is original to this framework:
+
+    r0 = open(&0:0:1="./f\\x00", 0x0, 0x0)
+    read(r0, &1:0:1, 0x10)
+    pipe(&2:0:1={r1, r2})
+
+  arg :=  0x<hex>                      integer value
+        | rN [/0x<div>] [+0x<add>]     resource reference (or declaration
+                                       when in an out-resource position)
+        | &pg:off:npg=<arg> | &pg:off:npg | &nil    pointer [+ pointee]
+        | &vma pg:npg                  vma address
+        | "<escaped bytes>"            data buffer
+        | {a, b, ...}                  struct/array
+        | @field=<arg>                 union option
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Tuple
+
+from .prog import (
+    Arg,
+    Call,
+    ConstArg,
+    DataArg,
+    GroupArg,
+    PointerArg,
+    Prog,
+    ResultArg,
+    ReturnArg,
+    UnionArg,
+    default_arg,
+    make_result_arg,
+)
+from .types import (
+    ArrayType,
+    BufferType,
+    Dir,
+    PtrType,
+    ResourceType,
+    StructType,
+    UnionType,
+    VmaType,
+    is_pad,
+)
+
+
+class DeserializeError(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------- #
+# Serialization
+
+
+def _escape(data: bytes) -> str:
+    out = []
+    for b in data:
+        if 32 <= b < 127 and b not in (ord('"'), ord("\\")):
+            out.append(chr(b))
+        else:
+            out.append(f"\\x{b:02x}")
+    return "".join(out)
+
+
+def serialize(p: Prog) -> str:
+    names: Dict[int, str] = {}
+    counter = [0]
+
+    def name_for(arg: Arg) -> str:
+        if id(arg) not in names:
+            names[id(arg)] = f"r{counter[0]}"
+            counter[0] += 1
+        return names[id(arg)]
+
+    def fmt(arg: Optional[Arg]) -> str:
+        if arg is None:
+            return "&nil"
+        if isinstance(arg, ConstArg):
+            return hex(arg.val)
+        if isinstance(arg, ResultArg):
+            ref = None
+            if arg.res is not None:
+                ref = names[id(arg.res)]
+                if arg.op_div:
+                    ref += f"/{hex(arg.op_div)}"
+                if arg.op_add:
+                    ref += f"+{hex(arg.op_add)}"
+            if arg.uses:
+                # this arg is itself a resource source: declare a name,
+                # chained to its own reference (r5=r3) or constant value
+                # (r5=0xffff..) so a round-trip preserves semantics
+                decl = name_for(arg)
+                if ref is None and arg.val != arg.typ.default():
+                    ref = hex(arg.val)
+                return f"{decl}={ref}" if ref is not None else decl
+            return ref if ref is not None else hex(arg.val)
+        if isinstance(arg, PointerArg):
+            if isinstance(arg.typ, VmaType):
+                return f"&vma {arg.page_index}:{arg.pages_num}"
+            head = f"&{arg.page_index}:{arg.page_offset}:{arg.pages_num}"
+            if arg.res is None:
+                # canonical null pointer collapses to &nil; any other
+                # pointee-less pointer keeps its address
+                if (arg.page_index, arg.page_offset, arg.pages_num) == (0, 0, 0):
+                    return "&nil"
+                return head
+            return f"{head}={fmt(arg.res)}"
+        if isinstance(arg, DataArg):
+            if arg.typ.dir == Dir.OUT:
+                # out-buffer contents are kernel-written; only length matters
+                return f"zero({hex(len(arg.data))})"
+            return f'"{_escape(arg.data)}"'
+        if isinstance(arg, GroupArg):
+            inner = [fmt(a) for a in arg.inner if not is_pad(a.typ)]
+            return "{" + ", ".join(inner) + "}"
+        if isinstance(arg, UnionArg):
+            return f"@{arg.option_type.field_name}={fmt(arg.option)}"
+        raise TypeError(f"cannot serialize {arg}")
+
+    lines = []
+    for c in p.calls:
+        body = f"{c.meta.name}({', '.join(fmt(a) for a in c.args)})"
+        if c.ret is not None and c.ret.uses:
+            body = f"{name_for(c.ret)} = {body}"
+        lines.append(body)
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------- #
+# Deserialization
+
+_TOK = re.compile(
+    r"""\s*(?:
+      (?P<str>"(?:\\x[0-9a-fA-F]{2}|[^"\\])*")
+    | (?P<res>r\d+)
+    | (?P<num>-?0x[0-9a-fA-F]+|-?\d+)
+    | (?P<name>[a-zA-Z_][\w$]*)
+    | (?P<punct>[=(){},:@&+/])
+    )""",
+    re.VERBOSE,
+)
+
+
+class _P:
+    def __init__(self, line: str):
+        self.toks: List[Tuple[str, str]] = []
+        i = 0
+        while i < len(line):
+            m = _TOK.match(line, i)
+            if not m:
+                if line[i:].strip() == "":
+                    break
+                raise DeserializeError(f"bad token at {line[i:]!r}")
+            i = m.end()
+            self.toks.append((m.lastgroup, m.group(m.lastgroup)))
+        self.i = 0
+
+    def peek(self):
+        return self.toks[self.i] if self.i < len(self.toks) else (None, None)
+
+    def next(self):
+        t = self.peek()
+        self.i += 1
+        return t
+
+    def accept(self, kind, val=None):
+        k, v = self.peek()
+        if k == kind and (val is None or v == val):
+            self.i += 1
+            return v
+        return None
+
+    def expect(self, kind, val=None):
+        v = self.accept(kind, val)
+        if v is None:
+            raise DeserializeError(
+                f"expected {val or kind}, got {self.peek()[1]!r}")
+        return v
+
+
+def _unescape_str(s: str) -> bytes:
+    s = s[1:-1]
+    out = bytearray()
+    i = 0
+    while i < len(s):
+        if s[i] == "\\" and i + 3 < len(s) + 1 and s[i + 1] == "x":
+            out.append(int(s[i + 2:i + 4], 16))
+            i += 4
+        else:
+            out.append(ord(s[i]))
+            i += 1
+    return bytes(out)
+
+
+def _strip_comment(raw: str) -> str:
+    """Cut at the first '#' that is outside a double-quoted string."""
+    in_str = False
+    i = 0
+    while i < len(raw):
+        ch = raw[i]
+        if in_str:
+            if ch == "\\":
+                i += 1
+            elif ch == '"':
+                in_str = False
+        elif ch == '"':
+            in_str = True
+        elif ch == "#":
+            return raw[:i]
+        i += 1
+    return raw
+
+
+def deserialize(target, text: str) -> Prog:
+    p = Prog(target, [])
+    bound: Dict[str, Arg] = {}
+
+    for raw in text.splitlines():
+        line = _strip_comment(raw).strip()
+        if not line:
+            continue
+        lx = _P(line)
+        first = lx.expect("name") if lx.peek()[0] == "name" else lx.expect("res")
+        ret_name = None
+        if lx.accept("punct", "="):
+            ret_name = first
+            call_name = lx.expect("name")
+        else:
+            call_name = first
+        meta = target.syscall_map.get(call_name)
+        if meta is None:
+            raise DeserializeError(f"unknown syscall {call_name!r}")
+        lx.expect("punct", "(")
+
+        def parse_arg(t) -> Arg:
+            k, v = lx.peek()
+            if k == "num":
+                lx.next()
+                val = int(v, 0)
+                if isinstance(t, ResourceType):
+                    return make_result_arg(t, None, val)
+                return ConstArg(t, val)
+            if k == "res":
+                lx.next()
+                src = bound.get(v)
+                if src is None:
+                    # Unbound name. In an out/inout position this is a
+                    # declaration of a new resource source; in a pure IN
+                    # position the defining line was lost (corpus decay) —
+                    # degrade to the default value without binding.
+                    arg = make_result_arg(t, None, t.default())
+                    if t.dir == Dir.IN:
+                        return arg
+                    bound[v] = arg
+                    if lx.accept("punct", "="):
+                        nk, nv = lx.peek()
+                        if nk == "num":
+                            lx.next()
+                            arg.val = int(nv, 0) & ((1 << 64) - 1)
+                            return arg
+                        refname = lx.expect("res")
+                        ref = bound.get(refname)
+                        if ref is None:
+                            raise DeserializeError(
+                                f"declaration {v}={refname} references "
+                                f"unbound {refname}")
+                        if lx.accept("punct", "/"):
+                            arg.op_div = int(lx.expect("num"), 0)
+                        if lx.accept("punct", "+"):
+                            arg.op_add = int(lx.expect("num"), 0)
+                        arg.res = ref
+                        arg.val = 0
+                        ref.uses.add(arg)
+                    return arg
+                op_div = op_add = 0
+                if lx.accept("punct", "/"):
+                    op_div = int(lx.expect("num"), 0)
+                if lx.accept("punct", "+"):
+                    op_add = int(lx.expect("num"), 0)
+                arg = make_result_arg(t, src, 0)
+                arg.op_div, arg.op_add = op_div, op_add
+                return arg
+            if k == "str":
+                lx.next()
+                return DataArg(t, _unescape_str(v))
+            if k == "name" and v == "zero":
+                lx.next()
+                lx.expect("punct", "(")
+                n = int(lx.expect("num"), 0)
+                lx.expect("punct", ")")
+                return DataArg(t, b"\x00" * n)
+            if k == "punct" and v == "&":
+                lx.next()
+                if lx.accept("name", "nil"):
+                    return PointerArg(t, 0, 0, 0, None)
+                if lx.accept("name", "vma"):
+                    pg = int(lx.expect("num"), 0)
+                    lx.expect("punct", ":")
+                    npg = int(lx.expect("num"), 0)
+                    return PointerArg(t, pg, 0, npg, None)
+                pg = int(lx.expect("num"), 0)
+                lx.expect("punct", ":")
+                off = int(lx.expect("num"), 0)
+                lx.expect("punct", ":")
+                npg = int(lx.expect("num"), 0)
+                res = None
+                if lx.accept("punct", "="):
+                    res = parse_arg(t.elem)
+                return PointerArg(t, pg, off, npg, res)
+            if k == "punct" and v == "{":
+                lx.next()
+                inner: List[Arg] = []
+                if isinstance(t, StructType):
+                    idx = 0
+                    for f in t.fields:
+                        if is_pad(f):
+                            inner.append(default_arg(f))
+                            continue
+                        if idx > 0:
+                            lx.expect("punct", ",")
+                        idx += 1
+                        inner.append(parse_arg(f))
+                    lx.expect("punct", "}")
+                    return GroupArg(t, inner)
+                # array
+                first_el = True
+                while not lx.accept("punct", "}"):
+                    if not first_el:
+                        lx.expect("punct", ",")
+                    first_el = False
+                    inner.append(parse_arg(t.elem))
+                return GroupArg(t, inner)
+            if k == "punct" and v == "@":
+                lx.next()
+                fname = lx.expect("name")
+                lx.expect("punct", "=")
+                opt_t = next((f for f in t.fields if f.field_name == fname),
+                             None)
+                if opt_t is None:
+                    raise DeserializeError(
+                        f"union {t.name} has no option {fname!r}")
+                return UnionArg(t, parse_arg(opt_t), opt_t)
+            raise DeserializeError(f"cannot parse arg from {v!r}")
+
+        args = []
+        for i, at in enumerate(meta.args):
+            if i > 0:
+                lx.expect("punct", ",")
+            args.append(parse_arg(at))
+        lx.expect("punct", ")")
+
+        ret = ReturnArg(meta.ret) if meta.ret is not None else ReturnArg(None)
+        c = Call(meta=meta, args=args, ret=ret)
+        if ret_name is not None:
+            bound[ret_name] = ret
+        p.calls.append(c)
+
+    # Rebind: any name declared by a ReturnArg must link uses (they were
+    # created with make_result_arg against the ReturnArg directly, so the
+    # use-edges are already present).
+    return p
+
+
+def call_set(text: str) -> List[str]:
+    """Names of calls mentioned in a serialized program (cheap, no target)."""
+    out = []
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = re.match(r"(?:r\d+\s*=\s*)?([a-zA-Z_][\w$]*)\(", line)
+        if m:
+            out.append(m.group(1))
+    return out

@@ -1,0 +1,241 @@
+"""Binary serialization of programs for the in-VM executor.
+
+Byte-compatible with the reference's executor wire format (reference:
+/root/reference/prog/encodingexec.go:14-288): a flat little-endian u64
+instruction stream of copyin/copyout markers, typed arg words, and an EOF
+sentinel, with pointers resolved to physical data-arena addresses
+(page_index*page_size + data_offset + page_offset). This is also the
+program<->tensor boundary format: the device tensor encoding in
+prog/tensor.py flattens to the same word stream.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional, Tuple
+
+from .prog import (
+    Arg,
+    Call,
+    ConstArg,
+    DataArg,
+    GroupArg,
+    PointerArg,
+    Prog,
+    ResultArg,
+    ReturnArg,
+    UnionArg,
+    foreach_subarg,
+    foreach_subarg_offset,
+)
+from .types import CsumType, Dir, PtrType, UINT64_MAX, VmaType, is_pad
+
+# Instruction markers (top of the u64 space, descending).
+EXEC_INSTR_EOF = UINT64_MAX
+EXEC_INSTR_COPYIN = UINT64_MAX - 1
+EXEC_INSTR_COPYOUT = UINT64_MAX - 2
+
+# Arg kinds.
+EXEC_ARG_CONST = 0
+EXEC_ARG_RESULT = 1
+EXEC_ARG_DATA = 2
+EXEC_ARG_CSUM = 3
+
+EXEC_ARG_CSUM_INET = 0
+EXEC_ARG_CSUM_CHUNK_DATA = 0
+EXEC_ARG_CSUM_CHUNK_CONST = 1
+
+EXEC_BUFFER_SIZE = 2 << 20
+
+_U64 = struct.Struct("<Q")
+
+
+class ExecBufferTooSmall(Exception):
+    pass
+
+
+class _Writer:
+    def __init__(self, limit: int):
+        self.parts: List[bytes] = []
+        self.size = 0
+        self.limit = limit
+
+    def word(self, v: int) -> None:
+        self.size += 8
+        if self.size > self.limit:
+            raise ExecBufferTooSmall()
+        self.parts.append(_U64.pack(v & UINT64_MAX))
+
+    def data(self, b: bytes) -> None:
+        pad = (8 - len(b) % 8) % 8
+        self.size += len(b) + pad
+        if self.size > self.limit:
+            raise ExecBufferTooSmall()
+        self.parts.append(b + b"\x00" * pad)
+
+    def bytes(self) -> bytes:
+        return b"".join(self.parts)
+
+
+def physical_addr(target, arg: PointerArg) -> int:
+    addr = arg.page_index * target.page_size + target.data_offset
+    if arg.page_offset >= 0:
+        addr += arg.page_offset
+    else:
+        addr += target.page_size - (-arg.page_offset)
+    return addr
+
+
+def serialize_for_exec(p: Prog, pid: int = 0,
+                       limit: int = EXEC_BUFFER_SIZE) -> bytes:
+    """Serialize program p for execution by process `pid`."""
+    target = p.target
+    w = _Writer(limit)
+    # arg identity -> (physical addr, instruction index)
+    addr_of: Dict[int, int] = {}
+    idx_of: Dict[int, int] = {}
+    instr_seq = 0
+
+    def write_arg(arg: Arg) -> None:
+        if isinstance(arg, ConstArg):
+            w.word(EXEC_ARG_CONST)
+            w.word(arg.size())
+            w.word(arg.value(pid))
+            w.word(arg.typ.bitfield_offset)
+            w.word(arg.typ.bitfield_length)
+        elif isinstance(arg, ResultArg):
+            if arg.res is None:
+                w.word(EXEC_ARG_CONST)
+                w.word(arg.size())
+                w.word(arg.val)
+                w.word(0)
+                w.word(0)
+            else:
+                w.word(EXEC_ARG_RESULT)
+                w.word(arg.size())
+                w.word(idx_of[id(arg.res)])
+                w.word(arg.op_div)
+                w.word(arg.op_add)
+        elif isinstance(arg, PointerArg):
+            w.word(EXEC_ARG_CONST)
+            w.word(arg.size())
+            w.word(physical_addr(target, arg))
+            w.word(0)
+            w.word(0)
+        elif isinstance(arg, DataArg):
+            w.word(EXEC_ARG_DATA)
+            w.word(len(arg.data))
+            w.data(arg.data)
+        else:
+            raise TypeError(f"cannot exec-serialize arg {arg}")
+
+    for c in p.calls:
+        # --- copyins for every pointer pointee ---
+        def gen_copyins(arg: Arg, _base):
+            nonlocal instr_seq
+            if not isinstance(arg, PointerArg) or arg.res is None:
+                return
+            base_addr = physical_addr(target, arg)
+
+            def per_sub(sub: Arg, offset: int):
+                nonlocal instr_seq
+                if isinstance(sub, (ResultArg, ReturnArg)) and sub.uses:
+                    addr_of[id(sub)] = base_addr + offset
+                if isinstance(sub, (GroupArg, UnionArg, ReturnArg)):
+                    return
+                if isinstance(sub, DataArg) and len(sub.data) == 0:
+                    return
+                if is_pad(sub.typ) or sub.typ.dir == Dir.OUT:
+                    return
+                w.word(EXEC_INSTR_COPYIN)
+                w.word(base_addr + offset)
+                write_arg(sub)
+                instr_seq += 1
+
+            foreach_subarg_offset(arg.res, per_sub)
+
+        for a in c.args:
+            foreach_subarg(a, gen_copyins)
+
+        # --- the call itself ---
+        w.word(c.meta.id)
+        w.word(len(c.args))
+        for a in c.args:
+            write_arg(a)
+        if c.ret is not None and c.ret.uses:
+            idx_of[id(c.ret)] = instr_seq
+        instr_seq += 1
+
+        # --- copyouts for kernel-written results inside pointees ---
+        def gen_copyouts(arg: Arg, _base):
+            nonlocal instr_seq
+            if isinstance(arg, ResultArg) and arg.uses:
+                w.word(EXEC_INSTR_COPYOUT)
+                w.word(addr_of[id(arg)])
+                w.word(arg.size())
+                idx_of[id(arg)] = instr_seq
+                instr_seq += 1
+
+        for a in c.args:
+            foreach_subarg(a, gen_copyouts)
+
+    w.word(EXEC_INSTR_EOF)
+    return w.bytes()
+
+
+def decode_exec(data: bytes) -> List[dict]:
+    """Decode an exec stream back into a structured instruction list (used by
+    tests and the mock executor; the C++ executor implements the same walk)."""
+    words = [(_U64.unpack_from(data, i)[0]) for i in range(0, len(data), 8)]
+    out: List[dict] = []
+    i = 0
+
+    def arg(i: int) -> Tuple[dict, int]:
+        kind = words[i]
+        if kind == EXEC_ARG_CONST:
+            return ({"kind": "const", "size": words[i + 1], "value": words[i + 2],
+                     "bf_off": words[i + 3], "bf_len": words[i + 4]}, i + 5)
+        if kind == EXEC_ARG_RESULT:
+            return ({"kind": "result", "size": words[i + 1], "index": words[i + 2],
+                     "div": words[i + 3], "add": words[i + 4]}, i + 5)
+        if kind == EXEC_ARG_DATA:
+            n = words[i + 1]
+            nw = (n + 7) // 8
+            raw = data[(i + 2) * 8:(i + 2) * 8 + n]
+            return ({"kind": "data", "size": n, "data": raw}, i + 2 + nw)
+        if kind == EXEC_ARG_CSUM:
+            size = words[i + 1]
+            ckind = words[i + 2]
+            nchunks = words[i + 3]
+            j = i + 4
+            chunks = []
+            for _ in range(nchunks):
+                chunks.append({"kind": words[j], "value": words[j + 1],
+                               "size": words[j + 2]})
+                j += 3
+            return ({"kind": "csum", "size": size, "csum_kind": ckind,
+                     "chunks": chunks}, j)
+        raise ValueError(f"bad exec arg kind {kind}")
+
+    while i < len(words):
+        wv = words[i]
+        if wv == EXEC_INSTR_EOF:
+            break
+        if wv == EXEC_INSTR_COPYIN:
+            a, j = arg(i + 2)
+            out.append({"op": "copyin", "addr": words[i + 1], "arg": a})
+            i = j
+        elif wv == EXEC_INSTR_COPYOUT:
+            out.append({"op": "copyout", "addr": words[i + 1],
+                        "size": words[i + 2]})
+            i += 3
+        else:
+            call_id = wv
+            nargs = words[i + 1]
+            i += 2
+            args = []
+            for _ in range(nargs):
+                a, i = arg(i)
+                args.append(a)
+            out.append({"op": "call", "id": call_id, "args": args})
+    return out

@@ -1,0 +1,476 @@
+"""Host-side program generation: the CPU reference implementation.
+
+Semantics-parity with the reference's randomized generator (reference:
+/root/reference/prog/rand.go:69-305,440-695 and prog/generation.go:12-31):
+magnitude-biased ints with a special-values table, quadratic biased choice,
+flag combination sampling, stateful filename/string pools, page-granular
+address allocation that synthesizes mmap calls, and recursive resource
+construction via ctor call sequences.
+
+On the hot path the framework uses the vmapped device generator
+(syzkaller_tpu.ops.generation); this module seeds corpora, regenerates the
+long tail the device kernels don't model (special structs, text), and is the
+baseline that bench.py compares against.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import List, Optional, Tuple
+
+from .analysis import State, analyze, assign_sizes_call
+from .prog import (
+    Arg,
+    Call,
+    ConstArg,
+    DataArg,
+    GroupArg,
+    PointerArg,
+    Prog,
+    ResultArg,
+    ReturnArg,
+    UnionArg,
+    default_arg,
+    foreach_arg,
+    make_result_arg,
+)
+from .types import (
+    ArrayKind,
+    ArrayType,
+    BufferKind,
+    BufferType,
+    ConstType,
+    CsumType,
+    Dir,
+    FlagsType,
+    IntKind,
+    IntType,
+    LenType,
+    ProcType,
+    PtrType,
+    ResourceType,
+    StructType,
+    Syscall,
+    UINT64_MAX,
+    UnionType,
+    VmaType,
+)
+
+SPECIAL_INTS = (
+    0, 1, 31, 32, 63, 64, 127, 128, 129, 255, 256, 257, 511, 512,
+    1023, 1024, 1025, 2047, 2048, 4095, 4096,
+    (1 << 15) - 1, 1 << 15, (1 << 15) + 1,
+    (1 << 16) - 1, 1 << 16, (1 << 16) + 1,
+    (1 << 31) - 1, 1 << 31, (1 << 31) + 1,
+    (1 << 32) - 1, 1 << 32, (1 << 32) + 1,
+)
+
+PUNCT = b"!@#$%^&*()-+\\/:.,-'[]{}"
+
+
+class RandGen:
+    """Seeded random value engine for program generation/mutation."""
+
+    def __init__(self, target, seed: Optional[int] = None,
+                 rng: Optional[random.Random] = None):
+        self.target = target
+        self.rng = rng if rng is not None else random.Random(seed)
+        self.in_create_resource = False
+        self.rec_depth: dict = {}
+
+    # --- primitive samplers ---
+
+    def intn(self, n: int) -> int:
+        return self.rng.randrange(n)
+
+    def rand(self, n: int) -> int:
+        return self.intn(n)
+
+    def rand_range(self, begin: int, end: int) -> int:
+        return begin + self.intn(end - begin + 1)
+
+    def bin(self) -> bool:
+        return self.intn(2) == 0
+
+    def one_of(self, n: int) -> bool:
+        return self.intn(n) == 0
+
+    def n_out_of(self, n: int, out_of: int) -> bool:
+        return self.intn(out_of) < n
+
+    def rand64(self) -> int:
+        return self.rng.getrandbits(64)
+
+    def rand_int(self) -> int:
+        """Magnitude-biased interesting integer."""
+        v = self.rand64()
+        if self.n_out_of(100, 182):
+            v %= 10
+        elif self.n_out_of(50, 82):
+            v = SPECIAL_INTS[self.intn(len(SPECIAL_INTS))]
+        elif self.n_out_of(10, 32):
+            v %= 256
+        elif self.n_out_of(10, 22):
+            v %= 4 << 10
+        elif self.n_out_of(10, 12):
+            v %= 64 << 10
+        else:
+            v %= 1 << 31
+        if self.n_out_of(100, 107):
+            pass
+        elif self.n_out_of(5, 7):
+            v = (-v) & UINT64_MAX
+        else:
+            v = (v << self.intn(63)) & UINT64_MAX
+        return v
+
+    def rand_range_int(self, begin: int, end: int) -> int:
+        if self.one_of(100):
+            return self.rand_int()
+        return begin + self.intn(end - begin + 1)
+
+    def biased_rand(self, n: int, k: int) -> int:
+        """Random int in [0, n); probability of n-1 is k times that of 0."""
+        nf, kf = float(n), float(k)
+        rf = nf * (kf / 2 + 1) * self.rng.random()
+        bf = (-1 + math.sqrt(1 + 2 * kf * rf / nf)) * nf / kf
+        return min(int(bf), n - 1)
+
+    def rand_array_len(self) -> int:
+        max_len = 10
+        return (max_len - self.biased_rand(max_len + 1, 10) + 1) % (max_len + 1)
+
+    def rand_buf_len(self) -> int:
+        if self.n_out_of(50, 56):
+            return self.rand(256)
+        if self.n_out_of(5, 6):
+            return 4 << 10
+        return 0
+
+    def rand_page_count(self) -> int:
+        if self.n_out_of(100, 106):
+            return self.rand(4) + 1
+        if self.n_out_of(5, 6):
+            return self.rand(20) + 1
+        return (self.rand(3) + 1) * 1024
+
+    def flags(self, vals: Tuple[int, ...]) -> int:
+        if not vals:
+            return self.rand64()
+        if self.n_out_of(90, 111):
+            v = 0
+            while True:
+                v |= vals[self.rand(len(vals))]
+                if self.bin():
+                    return v
+        if self.n_out_of(10, 21):
+            return vals[self.rand(len(vals))]
+        if self.n_out_of(10, 11):
+            return 0
+        return self.rand64()
+
+    def filename(self, s: State) -> bytes:
+        dir_ = "."
+        if self.one_of(2) and s.files:
+            dir_ = self.rng.choice(sorted(s.files))
+            if dir_.endswith("\x00"):
+                dir_ = dir_[:-1]
+        if not s.files or self.one_of(10):
+            i = 0
+            while True:
+                f = f"{dir_}/file{i}\x00"
+                if f not in s.files:
+                    return f.encode("latin1")
+                i += 1
+        return self.rng.choice(sorted(s.files)).encode("latin1")
+
+    def rand_string(self, s: State, values: Tuple[str, ...], dir: Dir) -> bytes:
+        data = self._rand_string_impl(s, values)
+        if dir == Dir.OUT:
+            return b"\x00" * len(data)
+        return data
+
+    def _rand_string_impl(self, s: State, values: Tuple[str, ...]) -> bytes:
+        if values:
+            return self.rng.choice(values).encode("latin1")
+        if s.strings and self.bin():
+            return self.rng.choice(sorted(s.strings)).encode("latin1")
+        buf = bytearray()
+        while self.n_out_of(3, 4):
+            if self.n_out_of(10, 21):
+                d = self.target.string_dictionary
+                if d:
+                    buf += self.rng.choice(d).encode("latin1")
+            elif self.n_out_of(10, 11):
+                buf.append(PUNCT[self.intn(len(PUNCT))])
+            else:
+                buf.append(self.intn(256))
+        if not self.one_of(100):
+            buf.append(0)
+        return bytes(buf)
+
+    def generate_text(self, kind) -> bytes:
+        # x86 codegen (the reference's ifuzz) lives in ops/textgen; the host
+        # fallback emits random bytes, which the kernel treats as an
+        # arbitrary (usually faulting) instruction stream.
+        return bytes(self.intn(256) for _ in range(50))
+
+    def mutate_text(self, kind, text: bytes) -> bytes:
+        from .mutation import mutate_data
+        return mutate_data(self, bytearray(text), 40, 60)
+
+    # --- address allocation ---
+
+    def _addr1(self, s: State, typ, size: int, data: Optional[Arg]):
+        npages = max(1, (size + self.target.page_size - 1)
+                     // self.target.page_size)
+        if self.bin():
+            return self.rand_page_addr(s, typ, npages, data, False), []
+        max_pages = self.target.num_pages
+        for i in range(max_pages - npages):
+            if not any(s.pages[i:i + npages]):
+                c = self.target.make_mmap(i, npages)
+                return PointerArg(typ, i, 0, 0, data), [c]
+        return self.rand_page_addr(s, typ, npages, data, False), []
+
+    def addr(self, s: State, typ, size: int, data: Optional[Arg]):
+        arg, calls = self._addr1(s, typ, size, data)
+        if self.n_out_of(50, 102):
+            pass
+        elif self.n_out_of(50, 52):
+            arg.page_offset = -size
+        elif self.n_out_of(1, 2):
+            arg.page_offset = self.intn(self.target.page_size)
+        elif size > 0:
+            arg.page_offset = -self.intn(size)
+        return arg, calls
+
+    def rand_page_addr(self, s: State, typ, npages: int,
+                       data: Optional[Arg], vma: bool) -> PointerArg:
+        starts = [i for i in range(self.target.num_pages - npages)
+                  if all(s.pages[i:i + npages])]
+        if starts:
+            page = starts[self.rand(len(starts))]
+        else:
+            page = self.rand(self.target.num_pages - npages)
+        return PointerArg(typ, page, 0, npages if vma else 0, data)
+
+    # --- resource construction ---
+
+    def create_resource(self, s: State, res: ResourceType):
+        if self.in_create_resource:
+            special = res.special_values
+            return make_result_arg(res, None, special[self.intn(len(special))]), []
+        self.in_create_resource = True
+        try:
+            kind = res.desc.name
+            if self.one_of(1000):
+                all_kinds = [k for k in self.target.resource_map
+                             if self.target.is_compatible_resource(
+                                 res.desc.kind[0], k)]
+                if all_kinds:
+                    kind = self.rng.choice(sorted(all_kinds))
+            metas = list(self.target.resource_ctors.get(kind, ()))
+            if s.ct is not None:
+                metas = [m for m in metas if s.ct.enabled(m.id)]
+            if not metas:
+                return make_result_arg(res, None, res.default()), []
+            for _ in range(1000):
+                meta = metas[self.intn(len(metas))]
+                calls = self.generate_particular_call(s, meta)
+                s1 = State(self.target, s.ct)
+                s1.analyze(calls[-1])
+                allres = []
+                for kind1, res1 in sorted(s1.resources.items()):
+                    if self.target.is_compatible_resource(kind, kind1):
+                        allres.extend(res1)
+                if allres:
+                    return make_result_arg(
+                        res, allres[self.intn(len(allres))], 0), calls
+                # Unsuccessful: unlink and discard.
+                for c in calls:
+                    def unlink(arg, _b):
+                        if isinstance(arg, ResultArg) and arg.res is not None:
+                            arg.res.uses.discard(arg)
+                    foreach_arg(c, unlink)
+            raise RuntimeError(f"failed to create a resource {res.desc.name}")
+        finally:
+            self.in_create_resource = False
+
+    # --- arg/call generation ---
+
+    def generate_call(self, s: State, p: Prog) -> List[Call]:
+        bias = -1
+        if p.calls:
+            for _ in range(5):
+                c = p.calls[self.intn(len(p.calls))].meta
+                bias = c.id
+                if c is not self.target.mmap_syscall:
+                    break
+        if s.ct is None:
+            meta = self.target.syscalls[self.intn(len(self.target.syscalls))]
+        else:
+            meta = self.target.syscalls[s.ct.choose(self.rng, bias)]
+        return self.generate_particular_call(s, meta)
+
+    def generate_particular_call(self, s: State, meta: Syscall) -> List[Call]:
+        c = Call(meta=meta, ret=ReturnArg(meta.ret))
+        c.args, calls = self.generate_args(s, meta.args)
+        assign_sizes_call(self.target, c)
+        calls = calls + [c]
+        for c1 in calls:
+            self.target.sanitize_call(c1)
+        return calls
+
+    def generate_args(self, s: State, types) -> Tuple[List[Arg], List[Call]]:
+        args, calls = [], []
+        for t in types:
+            arg, calls1 = self.generate_arg(s, t)
+            args.append(arg)
+            calls.extend(calls1)
+        return args, calls
+
+    def generate_arg(self, s: State, typ) -> Tuple[Arg, List[Call]]:
+        if typ.dir == Dir.OUT and isinstance(
+                typ, (IntType, FlagsType, ConstType, ProcType, VmaType,
+                      ResourceType)):
+            return default_arg(typ), []
+
+        if typ.optional and self.one_of(5):
+            return default_arg(typ), []
+
+        # Bound recursion through optional pointers to structs.
+        if isinstance(typ, PtrType) and typ.optional and \
+                isinstance(typ.elem, StructType):
+            key = typ.elem.name
+            if self.rec_depth.get(key, 0) >= 3:
+                return PointerArg(typ, 0, 0, 0, None), []
+            self.rec_depth[key] = self.rec_depth.get(key, 0) + 1
+            try:
+                return self._generate_arg_impl(s, typ)
+            finally:
+                self.rec_depth[key] -= 1
+                if not self.rec_depth[key]:
+                    del self.rec_depth[key]
+        return self._generate_arg_impl(s, typ)
+
+    def _generate_arg_impl(self, s: State, typ) -> Tuple[Arg, List[Call]]:
+        if isinstance(typ, ResourceType):
+            if self.n_out_of(1000, 1011):
+                allres = []
+                for name1, res1 in sorted(s.resources.items()):
+                    if self.target.is_compatible_resource(typ.desc.name, name1) \
+                            or (self.one_of(20) and
+                                self.target.is_compatible_resource(
+                                    typ.desc.kind[0], name1)):
+                        allres.extend(res1)
+                if allres:
+                    return make_result_arg(
+                        typ, allres[self.intn(len(allres))], 0), []
+                return self.create_resource(s, typ)
+            if self.n_out_of(10, 11):
+                return self.create_resource(s, typ)
+            special = typ.special_values
+            return make_result_arg(
+                typ, None, special[self.intn(len(special))]), []
+
+        if isinstance(typ, BufferType):
+            if typ.kind in (BufferKind.BLOB_RAND, BufferKind.BLOB_RANGE):
+                if typ.kind == BufferKind.BLOB_RANGE:
+                    sz = self.rand_range(typ.range_begin, typ.range_end)
+                else:
+                    sz = self.rand_buf_len()
+                if typ.dir == Dir.OUT:
+                    return DataArg(typ, b"\x00" * sz), []
+                return DataArg(typ, self.rng.randbytes(sz)), []
+            if typ.kind == BufferKind.STRING:
+                return DataArg(typ, self.rand_string(s, typ.values, typ.dir)), []
+            if typ.kind == BufferKind.FILENAME:
+                if typ.dir == Dir.OUT:
+                    if self.n_out_of(1, 3):
+                        n = self.intn(100)
+                    elif self.n_out_of(1, 2):
+                        n = 108
+                    else:
+                        n = 4096
+                    return DataArg(typ, b"\x00" * n), []
+                return DataArg(typ, self.filename(s)), []
+            if typ.kind == BufferKind.TEXT:
+                return DataArg(typ, self.generate_text(typ.text)), []
+            raise TypeError(f"unknown buffer kind {typ.kind}")
+
+        if isinstance(typ, VmaType):
+            npages = self.rand_page_count()
+            if typ.range_begin or typ.range_end:
+                npages = typ.range_begin + self.intn(
+                    typ.range_end - typ.range_begin + 1)
+            return self.rand_page_addr(s, typ, npages, None, True), []
+
+        if isinstance(typ, FlagsType):
+            return ConstArg(typ, self.flags(typ.vals)), []
+        if isinstance(typ, ConstType):
+            return ConstArg(typ, typ.val), []
+        if isinstance(typ, IntType):
+            if typ.kind == IntKind.FILEOFF:
+                if self.n_out_of(90, 101):
+                    v = 0
+                elif self.n_out_of(10, 11):
+                    v = self.rand(100)
+                else:
+                    v = self.rand_int()
+            elif typ.kind == IntKind.RANGE:
+                v = self.rand_range_int(typ.range_begin, typ.range_end)
+            else:
+                v = self.rand_int()
+            return ConstArg(typ, v), []
+        if isinstance(typ, ProcType):
+            return ConstArg(typ, self.rand(max(1, typ.values_per_proc))), []
+        if isinstance(typ, ArrayType):
+            if typ.kind == ArrayKind.RAND_LEN:
+                count = self.rand_array_len()
+            else:
+                count = self.rand_range(typ.range_begin, typ.range_end)
+            inner, calls = [], []
+            for _ in range(count):
+                a, cl = self.generate_arg(s, typ.elem)
+                inner.append(a)
+                calls.extend(cl)
+            return GroupArg(typ, inner), calls
+        if isinstance(typ, StructType):
+            gen = self.target.special_structs.get(typ.name)
+            if gen is not None and typ.dir != Dir.OUT:
+                return gen(self, s, typ, None)
+            args, calls = self.generate_args(s, typ.fields)
+            return GroupArg(typ, args), calls
+        if isinstance(typ, UnionType):
+            opt_t = typ.fields[self.intn(len(typ.fields))]
+            opt, calls = self.generate_arg(s, opt_t)
+            return UnionArg(typ, opt, opt_t), calls
+        if isinstance(typ, PtrType):
+            inner, calls = self.generate_arg(s, typ.elem)
+            arg, calls1 = self.addr(s, typ, inner.size(), inner)
+            return arg, calls + calls1
+        if isinstance(typ, LenType):
+            return ConstArg(typ, 0), []  # assigned by assign_sizes_call
+        if isinstance(typ, CsumType):
+            return ConstArg(typ, 0), []  # computed by the executor
+        raise TypeError(f"unknown type {typ}")
+
+
+def generate(target, rng_or_seed, ncalls: int, ct=None) -> Prog:
+    """Generate a random program of up to ncalls calls (reference:
+    /root/reference/prog/generation.go:12-31)."""
+    r = rng_or_seed if isinstance(rng_or_seed, RandGen) \
+        else RandGen(target, seed=rng_or_seed)
+    p = Prog(target, [])
+    s = State(target, ct)
+    while len(p.calls) < ncalls:
+        calls = r.generate_call(s, p)
+        for c in calls:
+            s.analyze(c)
+            p.calls.append(c)
+    if len(p.calls) > ncalls:
+        for i in range(len(p.calls) - 1, ncalls - 1, -1):
+            p.remove_call(i)
+    return p

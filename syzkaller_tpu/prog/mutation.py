@@ -1,0 +1,507 @@
+"""Host-side mutation + minimization (CPU reference implementation).
+
+Semantics parity with reference /root/reference/prog/mutation.go:12-250
+(weighted op mix: corpus splice 1/100, tail-biased call insertion 20/31,
+per-type arg mutation 10/11, call removal; 13-op byte-buffer mutator) and
+prog.Minimize (uber-mmap glue, back-to-front call removal, per-arg
+simplification with re-validation predicate).
+
+The hot path uses the batched device mutator (syzkaller_tpu.ops.mutation);
+this module is the semantic baseline it is property-tested against, and the
+minimizer (which is predicate-driven re-execution, inherently host-side).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Callable, List, Optional, Tuple
+
+from .analysis import State, analyze, assign_sizes_call
+from .generation import RandGen
+from .prog import (
+    Arg,
+    Call,
+    ConstArg,
+    DataArg,
+    GroupArg,
+    PointerArg,
+    Prog,
+    ResultArg,
+    UnionArg,
+    default_arg,
+    foreach_arg,
+    foreach_subarg,
+    make_result_arg,
+)
+from .types import (
+    ArrayKind,
+    ArrayType,
+    BufferKind,
+    BufferType,
+    ConstType,
+    CsumType,
+    Dir,
+    FlagsType,
+    IntType,
+    LenType,
+    ProcType,
+    PtrType,
+    ResourceType,
+    StructType,
+    UINT64_MAX,
+    UnionType,
+    VmaType,
+)
+
+MAX_INC = 35
+
+
+def _le(fmt: str, data: bytearray, i: int) -> int:
+    return struct.unpack_from("<" + fmt, data, i)[0]
+
+
+def _ple(fmt: str, data: bytearray, i: int, v: int) -> None:
+    size = struct.calcsize(fmt)
+    struct.pack_into("<" + fmt, data, i, v & ((1 << (8 * size)) - 1))
+
+
+def _be_add(data: bytearray, i: int, width: int, delta: int) -> None:
+    fmt = {2: "H", 4: "I", 8: "Q"}[width]
+    v = struct.unpack_from(">" + fmt, data, i)[0]
+    struct.pack_into(">" + fmt, data, i, (v + delta) & ((1 << (8 * width)) - 1))
+
+
+def mutate_data(r: RandGen, data: bytearray, min_len: int,
+                max_len: int) -> bytes:
+    """The 13-op byte/word-level buffer mutator."""
+    data = bytearray(data)
+    retry = True
+    while retry or not r.one_of(3):
+        retry = False
+        op = r.intn(13)
+        n = len(data)
+        if op == 0:  # append byte
+            if n >= max_len:
+                retry = True
+                continue
+            data.append(r.rand(256))
+        elif op == 1:  # remove byte
+            if n == 0 or n <= min_len:
+                retry = True
+                continue
+            del data[r.intn(n)]
+        elif op == 2:  # replace byte
+            if n == 0:
+                retry = True
+                continue
+            data[r.intn(n)] = r.rand(256)
+        elif op == 3:  # flip bit
+            if n == 0:
+                retry = True
+                continue
+            data[r.intn(n)] ^= 1 << r.intn(8)
+        elif op == 4:  # swap two bytes
+            if n < 2:
+                retry = True
+                continue
+            i1, i2 = r.intn(n), r.intn(n)
+            data[i1], data[i2] = data[i2], data[i1]
+        elif op == 5:  # add/sub byte
+            if n == 0:
+                retry = True
+                continue
+            i = r.intn(n)
+            delta = r.rand(2 * MAX_INC + 1) - MAX_INC or 1
+            data[i] = (data[i] + delta) & 0xFF
+        elif op in (6, 7, 8):  # add/sub u16/u32/u64 (either endianness)
+            width = {6: 2, 7: 4, 8: 8}[op]
+            if n < width:
+                retry = True
+                continue
+            i = r.intn(n - width + 1)
+            delta = r.rand(2 * MAX_INC + 1) - MAX_INC or 1
+            if r.bin():
+                fmt = {2: "H", 4: "I", 8: "Q"}[width]
+                _ple(fmt, data, i, _le(fmt, data, i) + delta)
+            else:
+                _be_add(data, i, width, delta)
+        elif op == 9:  # set byte to interesting value
+            if n == 0:
+                retry = True
+                continue
+            data[r.intn(n)] = r.rand_int() & 0xFF
+        elif op in (10, 11, 12):  # set u16/u32/u64 to interesting value
+            width = {10: 2, 11: 4, 12: 8}[op]
+            if n < width:
+                retry = True
+                continue
+            i = r.intn(n - width + 1)
+            fmt = {2: "H", 4: "I", 8: "Q"}[width]
+            v = r.rand_int() & ((1 << (8 * width)) - 1)
+            if r.bin():
+                v = int.from_bytes(v.to_bytes(width, "little"), "big")
+            _ple(fmt, data, i, v)
+    return bytes(data)
+
+
+def mutation_args(target, c: Call) -> Tuple[List[Arg], List[Optional[Arg]]]:
+    """Args eligible for mutation + their base pointer args."""
+    args: List[Arg] = []
+    bases: List[Optional[Arg]] = []
+
+    def visit(arg: Arg, base: Optional[Arg]):
+        t = arg.typ
+        if isinstance(t, StructType):
+            if target.special_structs.get(t.name) is None:
+                return  # only individual fields are mutated
+        elif isinstance(t, ArrayType):
+            if t.kind == ArrayKind.RANGE_LEN and t.range_begin == t.range_end:
+                return
+        elif isinstance(t, (LenType, CsumType, ConstType)):
+            return
+        elif isinstance(t, BufferType):
+            if t.kind == BufferKind.STRING and len(t.values) == 1:
+                return  # string const
+        if t.dir == Dir.OUT:
+            return
+        if base is not None and isinstance(base.typ.elem, StructType) and \
+                target.special_structs.get(base.typ.elem.name) is not None:
+            return  # special structs mutate as a whole
+        args.append(arg)
+        bases.append(base)
+
+    foreach_arg(c, visit)
+    return args, bases
+
+
+def mutate(p: Prog, rng_or_seed, ncalls: int, ct=None, corpus=None) -> None:
+    """Mutate program p in place."""
+    r = rng_or_seed if isinstance(rng_or_seed, RandGen) \
+        else RandGen(p.target, seed=rng_or_seed)
+    target = p.target
+    corpus = corpus or []
+
+    retry = True
+    stop = False
+    while retry or not stop:
+        if not retry:
+            stop = r.one_of(3)
+            if stop:
+                break
+        retry = False
+        if r.n_out_of(1, 100):
+            # splice with a random corpus program
+            if not corpus or not p.calls:
+                retry = True
+                continue
+            p0c = corpus[r.intn(len(corpus))].clone()
+            idx = r.intn(len(p.calls))
+            p.calls[idx:idx] = p0c.calls
+            while len(p.calls) > ncalls:
+                p.remove_call(len(p.calls) - 1)
+        elif r.n_out_of(20, 31):
+            # insert a new call, biased toward the tail
+            if len(p.calls) >= ncalls:
+                retry = True
+                continue
+            idx = r.biased_rand(len(p.calls) + 1, 5)
+            c = p.calls[idx] if idx < len(p.calls) else None
+            s = analyze(ct, p, c)
+            calls = r.generate_call(s, p)
+            p.insert_before(c, calls)
+        elif r.n_out_of(10, 11):
+            # mutate args of a random call
+            if not p.calls:
+                retry = True
+                continue
+            c = p.calls[r.intn(len(p.calls))]
+            if not c.args:
+                retry = True
+                continue
+            if c.meta is target.mmap_syscall and r.n_out_of(99, 100):
+                retry = True
+                continue
+            s = analyze(ct, p, c)
+            updated = False
+            while True:
+                args, bases = mutation_args(target, c)
+                if not args:
+                    retry = not updated
+                    break
+                idx = r.intn(len(args))
+                arg, base = args[idx], bases[idx]
+                base_size = 0
+                if base is not None and base.res is not None:
+                    base_size = base.res.size()
+                _mutate_arg(r, s, p, c, arg)
+                updated = True
+                if base is not None and base.res is not None and \
+                        base_size < base.res.size():
+                    na, calls1 = r.addr(s, base.typ, base.res.size(), base.res)
+                    for c1 in calls1:
+                        target.sanitize_call(c1)
+                    p.insert_before(c, calls1)
+                    base.page_index = na.page_index
+                    base.page_offset = na.page_offset
+                    base.pages_num = na.pages_num
+                assign_sizes_call(target, c)
+                if r.one_of(3):
+                    break
+        else:
+            # remove a random call
+            if not p.calls:
+                retry = True
+                continue
+            p.remove_call(r.intn(len(p.calls)))
+
+    for c in p.calls:
+        target.sanitize_call(c)
+
+
+def _mutate_arg(r: RandGen, s: State, p: Prog, c: Call, arg: Arg) -> None:
+    t = arg.typ
+    target = p.target
+    if isinstance(t, (IntType, FlagsType)):
+        if r.bin():
+            arg1, calls1 = r.generate_arg(s, t)
+            p.replace_arg(c, arg, arg1, calls1)
+        else:
+            if r.n_out_of(1, 3):
+                arg.val = (arg.val + r.intn(4) + 1) & UINT64_MAX
+            elif r.n_out_of(1, 2):
+                arg.val = (arg.val - r.intn(4) - 1) & UINT64_MAX
+            else:
+                arg.val ^= 1 << r.intn(64)
+    elif isinstance(t, (ResourceType, VmaType, ProcType)):
+        arg1, calls1 = r.generate_arg(s, t)
+        p.replace_arg(c, arg, arg1, calls1)
+    elif isinstance(t, BufferType):
+        if t.kind in (BufferKind.BLOB_RAND, BufferKind.BLOB_RANGE):
+            min_len, max_len = 0, UINT64_MAX
+            if t.kind == BufferKind.BLOB_RANGE:
+                min_len, max_len = t.range_begin, t.range_end
+            arg.data = mutate_data(r, bytearray(arg.data), min_len, max_len)
+        elif t.kind == BufferKind.STRING:
+            if r.bin():
+                min_len, max_len = 0, UINT64_MAX
+                if t.size != 0:
+                    min_len = max_len = t.size
+                arg.data = mutate_data(r, bytearray(arg.data), min_len, max_len)
+            else:
+                arg.data = r.rand_string(s, t.values, t.dir)
+        elif t.kind == BufferKind.FILENAME:
+            arg.data = r.filename(s)
+        elif t.kind == BufferKind.TEXT:
+            arg.data = r.mutate_text(t.text, arg.data)
+    elif isinstance(t, ArrayType):
+        count = len(arg.inner)
+        if t.kind == ArrayKind.RAND_LEN:
+            while count == len(arg.inner):
+                count = r.rand_array_len()
+        else:
+            if t.range_begin == t.range_end:
+                return
+            while count == len(arg.inner):
+                count = r.rand_range(t.range_begin, t.range_end)
+        if count > len(arg.inner):
+            calls: List[Call] = []
+            while count > len(arg.inner):
+                a1, calls1 = r.generate_arg(s, t.elem)
+                arg.inner.append(a1)
+                for c1 in calls1:
+                    calls.append(c1)
+                    s.analyze(c1)
+            for c1 in calls:
+                target.sanitize_call(c1)
+            target.sanitize_call(c)
+            p.insert_before(c, calls)
+        else:
+            for a1 in arg.inner[count:]:
+                p.remove_arg(c, a1)
+            del arg.inner[count:]
+    elif isinstance(t, PtrType):
+        if not isinstance(arg, PointerArg):
+            return
+        size = arg.res.size() if arg.res is not None else 1
+        arg1, calls1 = r.addr(s, t, size, arg.res)
+        p.replace_arg(c, arg, arg1, calls1)
+    elif isinstance(t, StructType):
+        gen = target.special_structs.get(t.name)
+        if gen is None:
+            raise TypeError("mutation_args returned a plain struct")
+        arg1, calls1 = gen(r, s, t, arg)
+        for i, f in enumerate(arg1.inner):
+            p.replace_arg(c, arg.inner[i], f, calls1)
+            calls1 = []
+    elif isinstance(t, UnionType):
+        options = [f for f in t.fields
+                   if f.field_name != arg.option_type.field_name]
+        if not options:
+            return
+        opt_t = options[r.intn(len(options))]
+        p.remove_arg(c, arg.option)
+        opt, calls = r.generate_arg(s, opt_t)
+        arg1 = UnionArg(t, opt, opt_t)
+        p.replace_arg(c, arg, arg1, calls)
+    else:
+        raise TypeError(f"cannot mutate arg of type {t}")
+
+
+# ---------------------------------------------------------------------- #
+# Minimization
+
+
+def minimize(p0: Prog, call_index0: int,
+             pred: Callable[[Prog, int], bool],
+             crash: bool = False) -> Tuple[Prog, int]:
+    """Iteratively simplify p0 while pred keeps holding."""
+    target = p0.target
+    name0 = p0.calls[call_index0].meta.name if call_index0 != -1 else ""
+
+    # 1. glue all mmaps into one uber-mmap
+    s = analyze(None, p0, None)
+    mapped = [i for i, m in enumerate(s.pages) if m]
+    if mapped and target.mmap_syscall is not None:
+        lo, hi = mapped[0], mapped[-1]
+        p = p0.clone()
+        ci = call_index0
+        i = 0
+        while i < len(p.calls):
+            if i != ci and p.calls[i].meta is target.mmap_syscall:
+                p.remove_call(i)
+                if i < ci:
+                    ci -= 1
+            else:
+                i += 1
+        p.calls.insert(0, target.make_mmap(lo, hi - lo + 1))
+        if ci != -1:
+            ci += 1
+        if pred(p, ci):
+            p0, call_index0 = p, ci
+
+    # 2. drop calls back-to-front
+    i = len(p0.calls) - 1
+    while i >= 0:
+        if i != call_index0:
+            ci = call_index0 - 1 if i < call_index0 else call_index0
+            p = p0.clone()
+            p.remove_call(i)
+            if pred(p, ci):
+                p0, call_index0 = p, ci
+        i -= 1
+
+    # 3. per-arg simplification
+    tried: set = set()
+
+    def rec(p: Prog, call: Call, arg: Arg, path: str) -> bool:
+        path += f"-{arg.typ.field_name}"
+        t = arg.typ
+        if isinstance(t, StructType):
+            return any(rec(p, call, a, path) for a in arg.inner)
+        if isinstance(t, UnionType):
+            return rec(p, call, arg.option, path)
+        if isinstance(t, PtrType):
+            if isinstance(arg, PointerArg) and arg.res is not None:
+                return rec(p, call, arg.res, path)
+            return False
+        if isinstance(t, ArrayType):
+            for i, inner in enumerate(list(arg.inner)):
+                ipath = f"{path}-{i}"
+                if ipath not in tried and not crash:
+                    can = (t.kind == ArrayKind.RANGE_LEN
+                           and len(arg.inner) > t.range_begin) or \
+                          t.kind == ArrayKind.RAND_LEN
+                    if can:
+                        arg.inner.remove(inner)
+                        p.remove_arg(call, inner)
+                        assign_sizes_call(target, call)
+                        nonlocal p0
+                        if pred(p, call_index0):
+                            p0 = p
+                        else:
+                            tried.add(ipath)
+                        return True
+                if rec(p, call, inner, ipath):
+                    return True
+            return False
+        if isinstance(t, (IntType, FlagsType, ProcType)):
+            if crash or path in tried:
+                return False
+            tried.add(path)
+            if arg.val == t.default():
+                return False
+            v0 = arg.val
+            arg.val = t.default()
+            if pred(p, call_index0):
+                p0 = p
+                return True
+            arg.val = v0
+            return False
+        if isinstance(t, ResourceType):
+            if crash or path in tried:
+                return False
+            tried.add(path)
+            if arg.res is None:
+                return False
+            r0 = arg.res
+            r0.uses.discard(arg)
+            arg.res, arg.val = None, t.default()
+            if pred(p, call_index0):
+                p0 = p
+                return True
+            arg.res, arg.val = r0, 0
+            r0.uses.add(arg)
+            return False
+        if isinstance(t, BufferType):
+            if path in tried:
+                return False
+            tried.add(path)
+            if t.kind not in (BufferKind.BLOB_RAND, BufferKind.BLOB_RANGE):
+                return False
+            min_len = t.range_begin
+            step = len(arg.data) - min_len
+            while len(arg.data) > min_len and step > 0:
+                if len(arg.data) - step >= min_len:
+                    saved = arg.data
+                    arg.data = arg.data[: len(arg.data) - step]
+                    assign_sizes_call(target, call)
+                    if pred(p, call_index0):
+                        continue
+                    arg.data = saved
+                    assign_sizes_call(target, call)
+                step //= 2
+                if crash:
+                    break
+            p0 = p
+            return False
+        return False
+
+    i = 0
+    while i < len(p0.calls):
+        tried = set()
+        while True:
+            p = p0.clone()
+            call = p.calls[i]
+            if not any(rec(p, call, a, str(j))
+                       for j, a in enumerate(list(call.args))):
+                break
+        i += 1
+
+    if call_index0 != -1:
+        if call_index0 >= len(p0.calls) or \
+                p0.calls[call_index0].meta.name != name0:
+            raise RuntimeError("bad call index after minimization")
+    return p0, call_index0
+
+
+def trim_after(p: Prog, idx: int) -> None:
+    """Drop all calls after idx, unlinking dataflow edges."""
+    for i in range(len(p.calls) - 1, idx, -1):
+        c = p.calls[i]
+
+        def unlink(arg: Arg, _b):
+            if isinstance(arg, ResultArg) and arg.res is not None:
+                arg.res.uses.discard(arg)
+
+        foreach_arg(c, unlink)
+    del p.calls[idx + 1:]

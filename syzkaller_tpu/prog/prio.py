@@ -1,0 +1,162 @@
+"""Call-pair priorities + choice table (host reference implementation).
+
+Semantics parity with reference /root/reference/prog/prio.go:27-247:
+static priorities from shared resource/struct/filename usage, dynamic
+priorities from corpus co-occurrence, normalization to [0.1, 1], and a
+per-row cumulative-sum choice table sampled by binary search. The numpy
+arrays produced here are exactly what the device sampler
+(syzkaller_tpu.ops.prio) uploads — prefix sums + searchsorted are already
+the array-friendly formulation.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .types import (
+    ArrayType,
+    BufferKind,
+    BufferType,
+    IntType,
+    PtrType,
+    ResourceType,
+    StructType,
+    Syscall,
+    UnionType,
+    VmaType,
+    foreach_type,
+)
+
+
+def calc_static_priorities(target) -> np.ndarray:
+    n = len(target.syscalls)
+    uses: Dict[str, Dict[int, float]] = {}
+
+    for c in target.syscalls:
+        def note(weight: float, ident: str, c=c):
+            m = uses.setdefault(ident, {})
+            if weight > m.get(c.id, 0.0):
+                m[c.id] = weight
+
+        def visit(t, c=c, note=note):
+            if isinstance(t, ResourceType):
+                if t.desc.name in ("pid", "uid", "gid"):
+                    # auxiliary ids that appear in many structs
+                    note(0.1, f"res{t.desc.name}")
+                else:
+                    ident = "res"
+                    for i, k in enumerate(t.desc.kind):
+                        ident += "-" + k
+                        w = 1.0 if i == len(t.desc.kind) - 1 else 0.2
+                        note(w, ident)
+            elif isinstance(t, PtrType):
+                if isinstance(t.elem, (StructType, UnionType)):
+                    note(1.0, f"ptrto-{t.elem.name}")
+                if isinstance(t.elem, ArrayType):
+                    note(1.0, f"ptrto-{t.elem.elem.name}")
+            elif isinstance(t, BufferType):
+                if t.kind == BufferKind.STRING and t.sub_kind:
+                    note(0.2, f"str-{t.sub_kind}")
+                elif t.kind == BufferKind.FILENAME:
+                    note(1.0, "filename")
+            elif isinstance(t, VmaType):
+                note(0.5, "vma")
+
+        foreach_type(c, visit)
+
+    prios = np.zeros((n, n), dtype=np.float32)
+    for calls in uses.values():
+        ids = list(calls.items())
+        for c0, w0 in ids:
+            for c1, w1 in ids:
+                if c0 != c1:
+                    prios[c0, c1] += w0 * w1
+    # self-priority = max priority wrt others
+    for c0 in range(n):
+        prios[c0, c0] = prios[c0].max()
+    normalize_prios(prios)
+    return prios
+
+
+def calc_dynamic_prio(target, corpus) -> np.ndarray:
+    n = len(target.syscalls)
+    prios = np.zeros((n, n), dtype=np.float32)
+    mmap = target.mmap_syscall
+    for p in corpus:
+        ids = [c.meta.id for c in p.calls
+               if mmap is None or c.meta is not mmap]
+        for id0 in ids:
+            for id1 in ids:
+                if id0 != id1:
+                    prios[id0, id1] += 1.0
+    normalize_prios(prios)
+    return prios
+
+
+def normalize_prios(prios: np.ndarray) -> None:
+    """Row-wise: zero entries get a small floor, then scale to [0.1, 1]."""
+    for row in prios:
+        mx = row.max()
+        if mx == 0:
+            row[:] = 1.0
+            continue
+        nz = row[row != 0]
+        mn = nz.min()
+        nzero = int((row == 0).sum())
+        if nzero:
+            mn = mn / (2 * nzero)
+        row[row == 0] = mn
+        if mx == mn:  # all-equal row: everything maps to the top of the range
+            row[:] = 1.0
+            continue
+        np.clip((row - mn) / (mx - mn) * 0.9 + 0.1, None, 1.0, out=row)
+
+
+def calculate_priorities(target, corpus) -> np.ndarray:
+    """static ⊙ dynamic."""
+    static = calc_static_priorities(target)
+    dynamic = calc_dynamic_prio(target, corpus)
+    return static * dynamic
+
+
+class ChoiceTable:
+    """Weighted next-call sampler: per-row integer prefix sums."""
+
+    def __init__(self, target, prios: Optional[np.ndarray],
+                 enabled: Optional[Sequence[Syscall]] = None):
+        self.target = target
+        calls = list(enabled) if enabled is not None else list(target.syscalls)
+        self.enabled_calls = calls
+        self._enabled_ids = {c.id for c in calls}
+        n = len(target.syscalls)
+        if prios is None:
+            prios = np.ones((n, n), dtype=np.float32)
+        mask = np.zeros(n, dtype=bool)
+        mask[[c.id for c in calls]] = True
+        weights = (prios * 1000).astype(np.int64) * mask[None, :]
+        self.run = np.cumsum(weights, axis=1)
+        self.run[~mask, :] = 0
+        self.mask = mask
+
+    def enabled(self, call_id: int) -> bool:
+        return call_id in self._enabled_ids
+
+    def choose(self, rng, bias_call: int = -1) -> int:
+        if bias_call < 0 or not self.mask[bias_call]:
+            return self.enabled_calls[rng.randrange(len(self.enabled_calls))].id
+        row = self.run[bias_call]
+        total = int(row[-1])
+        if total == 0:
+            return self.enabled_calls[rng.randrange(len(self.enabled_calls))].id
+        while True:
+            x = rng.randrange(total)
+            i = int(np.searchsorted(row, x, side="right"))
+            if self.mask[i]:
+                return i
+
+
+def build_choice_table(target, prios=None, enabled=None) -> ChoiceTable:
+    return ChoiceTable(target, prios, enabled)

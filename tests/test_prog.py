@@ -1,0 +1,135 @@
+"""Seeded randomized property tests over the program model.
+
+Mirrors the reference's prog test strategy (reference:
+/root/reference/prog/mutation_test.go:13-47, encoding_test.go,
+encodingexec_test.go): generate N random programs against the real linux
+target and check invariants — clone identity, mutation changes serialization,
+serialize/deserialize round-trips, exec-serialization decodes.
+"""
+
+import random
+
+import pytest
+
+from syzkaller_tpu.prog import get_target
+from syzkaller_tpu.prog.encoding import deserialize, serialize
+from syzkaller_tpu.prog.encodingexec import decode_exec, serialize_for_exec
+from syzkaller_tpu.prog.generation import RandGen, generate
+from syzkaller_tpu.prog.mutation import minimize, mutate
+from syzkaller_tpu.prog.prio import build_choice_table, calculate_priorities
+
+ITERS = 30
+NCALLS = 12
+
+
+@pytest.fixture(scope="module")
+def target():
+    return get_target("linux", "amd64")
+
+
+@pytest.fixture(scope="module")
+def ct(target):
+    prios = calculate_priorities(target, [])
+    return build_choice_table(target, prios)
+
+
+def test_generate_valid(target, ct):
+    for seed in range(ITERS):
+        p = generate(target, seed, NCALLS, ct)
+        assert 0 < len(p.calls) <= NCALLS
+        p.validate()
+
+
+def test_clone_identity(target, ct):
+    for seed in range(ITERS):
+        p = generate(target, seed, NCALLS, ct)
+        q = p.clone()
+        q.validate()
+        assert serialize(p) == serialize(q)
+
+
+def test_mutate_changes_program(target, ct):
+    changed = 0
+    for seed in range(ITERS):
+        p = generate(target, seed, NCALLS, ct)
+        s0 = serialize(p)
+        q = p.clone()
+        mutate(q, RandGen(target, seed=seed + 10_000), NCALLS, ct, [p])
+        q.validate()
+        if serialize(q) != s0:
+            changed += 1
+        # original must be untouched by mutating the clone
+        assert serialize(p) == s0
+    assert changed >= ITERS * 3 // 4
+
+
+def test_serialize_roundtrip(target, ct):
+    for seed in range(ITERS):
+        p = generate(target, seed, NCALLS, ct)
+        text = serialize(p)
+        q = deserialize(target, text)
+        q.validate()
+        assert serialize(q) == text
+
+
+def test_exec_serialization_decodes(target, ct):
+    for seed in range(ITERS):
+        p = generate(target, seed, NCALLS, ct)
+        data = serialize_for_exec(p, pid=0)
+        instrs = decode_exec(data)
+        ncalls = sum(1 for i in instrs if i["op"] == "call")
+        assert ncalls == len(p.calls)
+        for ins in instrs:
+            if ins["op"] == "call":
+                assert 0 <= ins["id"] < len(target.syscalls)
+                meta = target.syscalls[ins["id"]]
+                assert len(ins["args"]) == len(meta.args)
+
+
+def test_exec_result_refs_in_bounds(target, ct):
+    """ExecArgResult indices must reference earlier instructions."""
+    for seed in range(ITERS):
+        p = generate(target, seed, NCALLS, ct)
+        instrs = decode_exec(serialize_for_exec(p))
+        seen = 0
+        for ins in instrs:
+            if ins["op"] == "call":
+                for a in ins["args"]:
+                    if a["kind"] == "result":
+                        assert a["index"] < seen + len(ins["args"])
+            seen += 1
+
+
+def test_minimize_removes_calls(target, ct):
+    rng = random.Random(1)
+    for seed in range(10):
+        p = generate(target, seed, NCALLS, ct)
+        if len(p.calls) < 2:
+            continue
+        keep = p.calls[-1].meta.name
+        # predicate: program still contains the last call's syscall
+        q, ci = minimize(
+            p, len(p.calls) - 1,
+            lambda pp, ii: ii >= 0 and ii < len(pp.calls)
+            and pp.calls[ii].meta.name == keep)
+        q.validate()
+        assert q.calls[ci].meta.name == keep
+        assert len(q.calls) <= len(p.calls)
+
+
+def test_mutate_respects_ncalls(target, ct):
+    for seed in range(10):
+        p = generate(target, seed, 6, ct)
+        corpus = [generate(target, 1000 + seed, 6, ct)]
+        for step in range(5):
+            mutate(p, RandGen(target, seed=seed * 100 + step), 10, ct, corpus)
+        p.validate()
+        # ncalls is a soft cap (ctor-sequence insertion can overshoot, as in
+        # the reference); it must stay bounded
+        assert len(p.calls) <= 2 * 10
+
+
+def test_deterministic_generation(target, ct):
+    a = serialize(generate(target, 42, NCALLS, ct))
+    b = serialize(generate(target, 42, NCALLS, ct))
+    assert a == b
