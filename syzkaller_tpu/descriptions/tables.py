@@ -1,0 +1,548 @@
+"""Compile a Target into flat numpy tables for the device kernels.
+
+This is the TPU-first replacement for the reference's generated-Go type graph
+(reference: sys/syz-sysgen emitting sys/linux/<arch>.go): instead of walking
+typed trees at runtime, every syscall is flattened once into a *static slot
+template* — the exact sequence of exec-format atoms (register args + copyin
+fields) it produces — plus value-sampling metadata per slot. The batched
+JAX generation/mutation kernels then operate purely on integer tensors
+indexed by these tables.
+
+Design notes:
+  - Each call's pointee memory is modeled as one contiguous per-call byte
+    arena (the copyin image); pointer targets ("blocks") are static offsets
+    into it. Programs then need no page allocator on device: the encoder
+    prepends a single uber-mmap covering the arena (the same normalization
+    the reference's minimizer applies, prog/mutation.go:274-310).
+  - Variable-length constructs are instantiated at their minimum legal
+    shape (arrays at range_begin/1 element, unions at option 0); the host
+    mutator covers the long tail, per SURVEY.md §7 phase 2.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..prog.prio import calc_static_priorities
+from ..prog.types import (
+    ArrayKind,
+    ArrayType,
+    BufferKind,
+    BufferType,
+    ConstType,
+    CsumType,
+    Dir,
+    FlagsType,
+    IntKind,
+    IntType,
+    LenType,
+    ProcType,
+    PtrType,
+    ResourceType,
+    StructType,
+    Syscall,
+    UnionType,
+    VmaType,
+    is_pad,
+)
+
+# type-table kinds
+TK_INT = 0
+TK_FLAGS = 1
+TK_CONST = 2
+TK_LEN = 3
+TK_PROC = 4
+TK_CSUM = 5
+TK_RES = 6
+TK_BUF_BLOB = 7
+TK_BUF_STR = 8
+TK_BUF_FILE = 9
+TK_BUF_TEXT = 10
+TK_PTR = 11
+TK_VMA = 12
+
+# slot kinds
+SK_VALUE = 0   # scalar written as-is (register arg or copyin field)
+SK_REF = 1     # resource: references the ret of an earlier call (or default)
+SK_PTR = 2     # pointer to a block in the call arena
+SK_DATA = 3    # byte payload inside the call arena
+SK_VMA = 4     # address of N pages in the vma region
+SK_LEN = 5     # length of a sibling slot / enclosing block (recomputed)
+
+DEFAULT_BLOB_CAP = 64
+MAX_DATA_CAP = 512
+MAX_CALL_ARENA = 2048
+MAX_SLOTS_PER_CALL = 48
+
+
+@dataclass
+class _Slot:
+    type_idx: int
+    kind: int
+    is_arg: bool
+    arg_idx: int          # top-level arg position, or -1
+    block: int            # block id the slot's bytes live in (-1: register)
+    offset: int           # byte offset within the block
+    size: int             # byte width of the value (or data cap for SK_DATA)
+    res_kind: int = -1    # for SK_REF
+    len_target: int = -1  # for SK_LEN: slot index within this call
+    len_block: int = -1   # for SK_LEN buf == "parent": block id
+    default: int = 0
+    group: int = 0        # sibling scope for len resolution
+    fname: str = ""
+    target_block: int = -1  # for SK_PTR: the pointed-to block
+    str_off: int = -1     # for SK_DATA strings: offset into string pool
+    str_cnt: int = 0
+
+
+@dataclass
+class CompiledTables:
+    target: object
+    n_calls: int
+    n_res_kinds: int
+    res_kind_names: List[str]
+
+    # type table (indexed by slot type_idx)
+    type_kind: np.ndarray
+    type_size: np.ndarray
+    type_lo: np.ndarray        # int range lo / proc start / blob min len
+    type_hi: np.ndarray        # int range hi / proc per-proc / blob max len
+    type_flags_off: np.ndarray
+    type_flags_cnt: np.ndarray
+    type_default: np.ndarray
+    type_bf_off: np.ndarray
+    type_bf_len: np.ndarray
+    type_big_endian: np.ndarray
+    flags_pool: np.ndarray     # u64 values
+
+    # per-syscall
+    call_nargs: np.ndarray
+    call_slot_off: np.ndarray
+    call_slot_cnt: np.ndarray
+    call_arena_size: np.ndarray
+    call_vma_pages: np.ndarray     # pages consumed by vma slots
+    call_ret_kind: np.ndarray      # resource kind produced by ret (-1 none)
+    call_res_out: np.ndarray       # [n_calls, n_res_kinds] u8 produces-matrix
+    call_res_in: np.ndarray        # [n_calls, n_res_kinds] u8 needs-matrix
+
+    # flattened slot templates
+    slot_type: np.ndarray
+    slot_kind: np.ndarray
+    slot_is_arg: np.ndarray
+    slot_arg_idx: np.ndarray
+    slot_block: np.ndarray
+    slot_offset: np.ndarray
+    slot_size: np.ndarray
+    slot_res_kind: np.ndarray
+    slot_len_target: np.ndarray
+    slot_len_block: np.ndarray
+    slot_default: np.ndarray
+    slot_target_block: np.ndarray
+    slot_str_off: np.ndarray
+    slot_str_cnt: np.ndarray
+
+    # per-call block layout
+    call_block_off: np.ndarray     # into block_size/block_addr
+    call_block_cnt: np.ndarray
+    block_size: np.ndarray
+    block_addr: np.ndarray         # static offset within the call arena
+
+    # string pool
+    str_data: np.ndarray           # [n_strings, MAX_DATA_CAP] u8
+    str_len: np.ndarray
+
+    # resource machinery
+    res_compat: np.ndarray         # [R, R] u8: can src kind satisfy dst kind
+    ctor_of_kind: np.ndarray       # [R] preferred ctor syscall id (-1 none)
+
+    # priorities
+    prio_static: np.ndarray        # [n_calls, n_calls] f32
+
+    # bookkeeping for decode
+    max_slots: int = 0
+    max_arena: int = 0
+
+    def call_name(self, call_id: int) -> str:
+        return self.target.syscalls[call_id].name
+
+
+class _TypeTable:
+    def __init__(self):
+        self.rows: List[tuple] = []
+        self.memo: Dict[tuple, int] = {}
+        self.flags_pool: List[int] = []
+        self.str_data: List[bytes] = []
+
+    def add_flags(self, vals: Tuple[int, ...]) -> Tuple[int, int]:
+        off = len(self.flags_pool)
+        self.flags_pool.extend(vals)
+        return off, len(vals)
+
+    def add_strings(self, vals: Tuple[str, ...]) -> Tuple[int, int]:
+        off = len(self.str_data)
+        for v in vals:
+            self.str_data.append(v.encode("latin1")[:MAX_DATA_CAP])
+        return off, len(vals)
+
+    def intern(self, key: tuple, row: tuple) -> int:
+        if key in self.memo:
+            return self.memo[key]
+        idx = len(self.rows)
+        self.rows.append(row)
+        self.memo[key] = idx
+        return idx
+
+
+def _res_kind_index(target) -> Dict[str, int]:
+    return {r.name: i for i, r in enumerate(target.resources)}
+
+
+def compile_tables(target) -> CompiledTables:
+    res_idx = _res_kind_index(target)
+    nres = len(res_idx)
+    tt = _TypeTable()
+
+    U64 = (1 << 64) - 1
+
+    def type_row(t, tk: int, lo=0, hi=0, foff=0, fcnt=0, default=0,
+                 soff=-1, scnt=0) -> int:
+        key = (tk, t.size, lo & U64, hi & U64, foff, fcnt, default & U64,
+               t.bitfield_offset, t.bitfield_length,
+               getattr(t, "big_endian", False), soff, scnt)
+        return tt.intern(key, key)
+
+    all_slots: List[_Slot] = []
+    call_slot_off: List[int] = []
+    call_slot_cnt: List[int] = []
+    call_arena: List[int] = []
+    call_vma_pages: List[int] = []
+    call_nargs: List[int] = []
+    call_ret_kind: List[int] = []
+    call_res_out = np.zeros((len(target.syscalls), nres), dtype=np.uint8)
+    call_res_in = np.zeros((len(target.syscalls), nres), dtype=np.uint8)
+    call_block_off: List[int] = []
+    call_block_cnt: List[int] = []
+    block_sizes: List[int] = []
+    block_addrs: List[int] = []
+
+    for ci, meta in enumerate(target.syscalls):
+        slots: List[_Slot] = []
+        blocks: List[int] = []  # sizes
+        vma_pages = [0]
+        group_counter = [0]
+
+        def new_block(size: int) -> int:
+            bid = len(blocks)
+            blocks.append(min(size, MAX_CALL_ARENA))
+            return bid
+
+        def flatten(t, is_arg: bool, arg_idx: int, block: int, offset: int,
+                    group: int) -> int:
+            """Append slots for type t; returns its byte size in the block."""
+            if len(slots) >= MAX_SLOTS_PER_CALL:
+                return 0 if t.is_varlen else t.size
+            if isinstance(t, ResourceType):
+                rk = res_idx[t.desc.name]
+                if t.dir != Dir.IN:
+                    call_res_out[ci, rk] = 1
+                    # kernel writes it; device models as value slot
+                    ti = type_row(t, TK_RES, default=t.default())
+                    slots.append(_Slot(ti, SK_VALUE, is_arg, arg_idx, block,
+                                       offset, t.size, res_kind=rk,
+                                       default=t.default(), group=group,
+                                       fname=t.field_name))
+                else:
+                    call_res_in[ci, rk] = 1
+                    ti = type_row(t, TK_RES, default=t.default())
+                    slots.append(_Slot(ti, SK_REF, is_arg, arg_idx, block,
+                                       offset, t.size, res_kind=rk,
+                                       default=t.default(), group=group,
+                                       fname=t.field_name))
+                return t.size
+            if isinstance(t, (IntType,)):
+                lo, hi = (t.range_begin, t.range_end) \
+                    if t.kind == IntKind.RANGE else (0, 0)
+                ti = type_row(t, TK_INT, lo=lo, hi=hi)
+                slots.append(_Slot(ti, SK_VALUE, is_arg, arg_idx, block,
+                                   offset, t.size, group=group,
+                                   fname=t.field_name))
+                return t.size if not t.bitfield_middle else 0
+            if isinstance(t, FlagsType):
+                foff, fcnt = tt.add_flags(t.vals)
+                ti = type_row(t, TK_FLAGS, foff=foff, fcnt=fcnt)
+                slots.append(_Slot(ti, SK_VALUE, is_arg, arg_idx, block,
+                                   offset, t.size, group=group,
+                                   fname=t.field_name))
+                return t.size if not t.bitfield_middle else 0
+            if isinstance(t, ConstType):
+                if is_pad(t):
+                    return t.size
+                ti = type_row(t, TK_CONST, default=t.val)
+                slots.append(_Slot(ti, SK_VALUE, is_arg, arg_idx, block,
+                                   offset, t.size, default=t.val, group=group,
+                                   fname=t.field_name))
+                return t.size if not t.bitfield_middle else 0
+            if isinstance(t, ProcType):
+                ti = type_row(t, TK_PROC, lo=t.values_start,
+                              hi=t.values_per_proc)
+                slots.append(_Slot(ti, SK_VALUE, is_arg, arg_idx, block,
+                                   offset, t.size, group=group,
+                                   fname=t.field_name))
+                return t.size if not t.bitfield_middle else 0
+            if isinstance(t, CsumType):
+                ti = type_row(t, TK_CSUM)
+                slots.append(_Slot(ti, SK_VALUE, is_arg, arg_idx, block,
+                                   offset, t.size, group=group,
+                                   fname=t.field_name))
+                return t.size
+            if isinstance(t, LenType):
+                ti = type_row(t, TK_LEN, lo=t.byte_size)
+                slots.append(_Slot(ti, SK_LEN, is_arg, arg_idx, block,
+                                   offset, t.size, group=group,
+                                   fname=t.field_name))
+                # len target resolved after the call is flattened
+                slots[-1].len_target = -1
+                slots[-1].fname = t.field_name
+                slots[-1].str_off = -1
+                slots[-1].len_block = -1
+                slots[-1].__dict__["len_buf"] = t.buf
+                return t.size if not t.bitfield_middle else 0
+            if isinstance(t, VmaType):
+                npages = max(1, t.range_begin)
+                ti = type_row(t, TK_VMA, lo=t.range_begin, hi=t.range_end)
+                slots.append(_Slot(ti, SK_VMA, is_arg, arg_idx, block,
+                                   offset, t.size, default=npages,
+                                   group=group, fname=t.field_name))
+                vma_pages[0] += npages
+                return t.size
+            if isinstance(t, BufferType):
+                if t.kind == BufferKind.STRING:
+                    soff, scnt = tt.add_strings(t.values)
+                    cap = t.size or max(
+                        [len(v) for v in t.values] + [DEFAULT_BLOB_CAP])
+                    cap = min(cap, MAX_DATA_CAP)
+                    ti = type_row(t, TK_BUF_STR, soff=soff, scnt=scnt)
+                    sl = _Slot(ti, SK_DATA, is_arg, arg_idx, block, offset,
+                               cap, group=group, fname=t.field_name,
+                               str_off=soff, str_cnt=scnt)
+                    slots.append(sl)
+                    return cap
+                if t.kind == BufferKind.FILENAME:
+                    ti = type_row(t, TK_BUF_FILE)
+                    cap = min(t.size or 16, MAX_DATA_CAP)
+                    slots.append(_Slot(ti, SK_DATA, is_arg, arg_idx, block,
+                                       offset, cap, group=group,
+                                       fname=t.field_name))
+                    return cap
+                tk = TK_BUF_TEXT if t.kind == BufferKind.TEXT else TK_BUF_BLOB
+                lo = t.range_begin
+                hi = t.range_end if t.kind == BufferKind.BLOB_RANGE \
+                    else DEFAULT_BLOB_CAP
+                cap = min(t.size or max(hi, 1), MAX_DATA_CAP)
+                ti = type_row(t, tk, lo=lo, hi=min(hi, cap))
+                slots.append(_Slot(ti, SK_DATA, is_arg, arg_idx, block,
+                                   offset, cap, group=group,
+                                   fname=t.field_name))
+                return cap
+            if isinstance(t, PtrType):
+                elem = t.elem
+                esize = elem.size if not elem.is_varlen else 0
+                bid = new_block(max(esize, 8))
+                ti = type_row(t, TK_PTR)
+                sl = _Slot(ti, SK_PTR, is_arg, arg_idx, block, offset, t.size,
+                           group=group, fname=t.field_name, target_block=bid)
+                slots.append(sl)
+                g = group_counter[0] = group_counter[0] + 1
+                inner = flatten(elem, False, -1, bid, 0, g)
+                blocks[bid] = min(max(blocks[bid], inner, 1), MAX_CALL_ARENA)
+                return t.size
+            if isinstance(t, StructType):
+                off = 0
+                g = group_counter[0] = group_counter[0] + 1
+                for f in t.fields:
+                    sz = flatten(f, False, -1, block, offset + off, g)
+                    if is_pad(f):
+                        off += f.size
+                    elif not f.bitfield_middle:
+                        off += sz if f.is_varlen or not isinstance(
+                            f, BufferType) else sz
+                return off if t.is_varlen else max(t.size, off)
+            if isinstance(t, UnionType):
+                g = group_counter[0] = group_counter[0] + 1
+                inner = flatten(t.fields[0], False, -1, block, offset, g)
+                return t.size if not t.is_varlen else inner
+            if isinstance(t, ArrayType):
+                if t.kind == ArrayKind.RANGE_LEN:
+                    count = max(t.range_begin, 1)
+                else:
+                    count = 1
+                off = 0
+                g = group_counter[0] = group_counter[0] + 1
+                for _ in range(count):
+                    off += flatten(t.elem, False, -1, block, offset + off, g)
+                    if len(slots) >= MAX_SLOTS_PER_CALL:
+                        break
+                return off
+            raise TypeError(f"cannot flatten {t}")
+
+        for i, at in enumerate(meta.args):
+            flatten(at, True, i, -1, 0, 0)
+
+        # resolve len targets: sibling field in the same group, else
+        # the enclosing block ("parent")
+        for si, sl in enumerate(slots):
+            if sl.kind != SK_LEN:
+                continue
+            buf = sl.__dict__.get("len_buf", "")
+            target_si = -1
+            for sj, other in enumerate(slots):
+                if sj != si and other.group == sl.group \
+                        and other.fname == buf:
+                    target_si = sj
+                    break
+            if target_si >= 0:
+                # a len of a pointer arg measures its pointee block
+                if slots[target_si].kind == SK_PTR:
+                    sl.len_block = slots[target_si].target_block
+                    sl.len_target = -1
+                else:
+                    sl.len_target = target_si
+            elif buf == "parent" and sl.block >= 0:
+                sl.len_block = sl.block
+            else:
+                sl.len_target = -1  # stays at default 0
+
+        # lay out blocks inside the call arena (8-byte aligned)
+        addrs = []
+        cur = 0
+        for bs in blocks:
+            addrs.append(cur)
+            cur += (bs + 7) & ~7
+        cur = min(cur, MAX_CALL_ARENA)
+
+        call_slot_off.append(len(all_slots))
+        call_slot_cnt.append(len(slots))
+        call_arena.append(cur)
+        call_vma_pages.append(vma_pages[0])
+        call_nargs.append(len(meta.args))
+        rk = -1
+        if meta.ret is not None and isinstance(meta.ret, ResourceType):
+            rk = res_idx[meta.ret.desc.name]
+            call_res_out[ci, rk] = 1
+        call_ret_kind.append(rk)
+        call_block_off.append(len(block_sizes))
+        call_block_cnt.append(len(blocks))
+        block_sizes.extend(blocks)
+        block_addrs.extend(addrs)
+        all_slots.extend(slots)
+
+    # resource compat matrix + preferred ctors
+    res_compat = np.zeros((max(nres, 1), max(nres, 1)), dtype=np.uint8)
+    for dname, di in res_idx.items():
+        for sname, si in res_idx.items():
+            if target.is_compatible_resource(dname, sname):
+                res_compat[di, si] = 1
+    ctor_of_kind = np.full(max(nres, 1), -1, dtype=np.int32)
+    for rname, ri in res_idx.items():
+        # prefer ctors that produce exactly this kind (socket for sock,
+        # not any fd producer); fall back to imprecise
+        ctors = target.calc_resource_ctors(
+            target.resource_map[rname].kind, precise=True) \
+            or target.resource_ctors.get(rname, [])
+        if ctors:
+            # cheapest ctor: fewest input resources, then fewest slots
+            best = min(
+                ctors,
+                key=lambda m: (int(call_res_in[m.id].sum()),
+                               call_slot_cnt[m.id]))
+            ctor_of_kind[ri] = best.id
+
+    # type table columns
+    n_types = len(tt.rows)
+    cols = list(zip(*tt.rows)) if n_types else [[]] * 12
+    type_kind = np.array(cols[0], dtype=np.int32)
+    type_size = np.array(cols[1], dtype=np.int32)
+    type_lo = np.array(cols[2], dtype=np.uint64)
+    type_hi = np.array(cols[3], dtype=np.uint64)
+    type_flags_off = np.array(cols[4], dtype=np.int32)
+    type_flags_cnt = np.array(cols[5], dtype=np.int32)
+    type_default = np.array(cols[6], dtype=np.uint64)
+    type_bf_off = np.array(cols[7], dtype=np.int32)
+    type_bf_len = np.array(cols[8], dtype=np.int32)
+    type_big_endian = np.array(cols[9], dtype=np.uint8)
+
+    str_data = np.zeros((max(len(tt.str_data), 1), MAX_DATA_CAP),
+                        dtype=np.uint8)
+    str_len = np.zeros(max(len(tt.str_data), 1), dtype=np.int32)
+    for i, b in enumerate(tt.str_data):
+        str_data[i, :len(b)] = np.frombuffer(b, dtype=np.uint8)
+        str_len[i] = len(b)
+
+    U64 = (1 << 64) - 1
+
+    def col(attr, dtype=np.int32):
+        vals = [getattr(s, attr) for s in all_slots] or [0]
+        if dtype == np.uint64:
+            vals = [v & U64 for v in vals]
+        return np.array(vals, dtype=dtype)
+
+    tables = CompiledTables(
+        target=target,
+        n_calls=len(target.syscalls),
+        n_res_kinds=nres,
+        res_kind_names=list(res_idx),
+        type_kind=type_kind, type_size=type_size, type_lo=type_lo,
+        type_hi=type_hi, type_flags_off=type_flags_off,
+        type_flags_cnt=type_flags_cnt, type_default=type_default,
+        type_bf_off=type_bf_off, type_bf_len=type_bf_len,
+        type_big_endian=type_big_endian,
+        flags_pool=np.array([v & ((1 << 64) - 1) for v in tt.flags_pool]
+                            or [0], dtype=np.uint64),
+        call_nargs=np.array(call_nargs, dtype=np.int32),
+        call_slot_off=np.array(call_slot_off, dtype=np.int32),
+        call_slot_cnt=np.array(call_slot_cnt, dtype=np.int32),
+        call_arena_size=np.array(call_arena, dtype=np.int32),
+        call_vma_pages=np.array(call_vma_pages, dtype=np.int32),
+        call_ret_kind=np.array(call_ret_kind, dtype=np.int32),
+        call_res_out=call_res_out,
+        call_res_in=call_res_in,
+        slot_type=col("type_idx"),
+        slot_kind=col("kind"),
+        slot_is_arg=col("is_arg", np.uint8),
+        slot_arg_idx=col("arg_idx"),
+        slot_block=col("block"),
+        slot_offset=col("offset"),
+        slot_size=col("size"),
+        slot_res_kind=col("res_kind"),
+        slot_len_target=col("len_target"),
+        slot_len_block=col("len_block"),
+        slot_default=col("default", np.uint64),
+        slot_target_block=col("target_block"),
+        slot_str_off=col("str_off"),
+        slot_str_cnt=col("str_cnt"),
+        call_block_off=np.array(call_block_off, dtype=np.int32),
+        call_block_cnt=np.array(call_block_cnt, dtype=np.int32),
+        block_size=np.array(block_sizes or [0], dtype=np.int32),
+        block_addr=np.array(block_addrs or [0], dtype=np.int32),
+        str_data=str_data,
+        str_len=str_len,
+        res_compat=res_compat,
+        ctor_of_kind=ctor_of_kind,
+        prio_static=calc_static_priorities(target),
+        max_slots=int(max(call_slot_cnt)) if call_slot_cnt else 0,
+        max_arena=int(max(call_arena)) if call_arena else 0,
+    )
+    return tables
+
+
+_cache: Dict[int, CompiledTables] = {}
+
+
+def get_tables(target) -> CompiledTables:
+    key = id(target)
+    if key not in _cache:
+        _cache[key] = compile_tables(target)
+    return _cache[key]

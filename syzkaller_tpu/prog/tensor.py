@@ -1,0 +1,443 @@
+"""Fixed-width tensor encoding of programs (the device representation).
+
+A batch of programs is three arrays (struct-of-arrays, jit/vmap friendly):
+
+    call_id  [B, C]      i32   syscall id per call slot, -1 = empty
+    slot_val [B, C, S]   u64   per template slot: value / producer call
+                               index (REF) / payload length (DATA) /
+                               page count (VMA); PTR and LEN slots are
+                               fully determined by the static template
+    data     [B, C, D]   u8    per-call copyin arena image (byte payloads)
+
+Everything else — which slots exist, their kinds/types/offsets, block
+layout, addresses — is static per syscall id and lives in the compiled
+tables (descriptions/tables.py). The encoder assigns each call one page of
+the data area and prepends a single uber-mmap, mirroring the reference
+minimizer's mmap normalization (reference: prog/mutation.go:274-310, and
+the exec-format physical addressing of prog/encodingexec.go:202-214).
+
+REF sentinel: REF_NONE means "no producer" -> the type's default value.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from ..descriptions.tables import (
+    SK_DATA,
+    SK_LEN,
+    SK_PTR,
+    SK_REF,
+    SK_VALUE,
+    SK_VMA,
+    CompiledTables,
+    MAX_SLOTS_PER_CALL,
+)
+from .analysis import assign_sizes_call
+from .prog import (
+    Arg,
+    Call,
+    ConstArg,
+    DataArg,
+    GroupArg,
+    PointerArg,
+    Prog,
+    ResultArg,
+    ReturnArg,
+    UnionArg,
+    default_arg,
+    make_result_arg,
+)
+from .types import (
+    ArrayKind,
+    ArrayType,
+    BufferKind,
+    BufferType,
+    ConstType,
+    CsumType,
+    Dir,
+    FlagsType,
+    IntType,
+    LenType,
+    ProcType,
+    PtrType,
+    ResourceType,
+    StructType,
+    UINT64_MAX,
+    UnionType,
+    VmaType,
+    is_pad,
+)
+
+REF_NONE = UINT64_MAX
+
+
+@dataclass
+class TensorFormat:
+    max_calls: int = 16
+    max_slots: int = 16
+    arena: int = 320  # bytes per call, 8-aligned
+
+    @classmethod
+    def for_tables(cls, tables: CompiledTables, max_calls: int = 16):
+        return cls(
+            max_calls=max_calls,
+            max_slots=max(int(tables.max_slots), 1),
+            arena=(max(int(tables.max_arena), 8) + 7) & ~7,
+        )
+
+
+@dataclass
+class ProgBatch:
+    """Host-side (numpy) batch; device code treats it as a pytree of arrays."""
+
+    call_id: np.ndarray   # [B, C] int32
+    slot_val: np.ndarray  # [B, C, S] uint64
+    data: np.ndarray      # [B, C, D] uint8
+
+    @property
+    def batch(self) -> int:
+        return self.call_id.shape[0]
+
+    @classmethod
+    def empty(cls, fmt: TensorFormat, batch: int) -> "ProgBatch":
+        return cls(
+            call_id=np.full((batch, fmt.max_calls), -1, dtype=np.int32),
+            slot_val=np.zeros((batch, fmt.max_calls, fmt.max_slots),
+                              dtype=np.uint64),
+            data=np.zeros((batch, fmt.max_calls, fmt.arena), dtype=np.uint8),
+        )
+
+
+# ---------------------------------------------------------------------- #
+# Template-shaped tree construction + slot-order walking.
+# The walk order here MUST mirror descriptions/tables.py::flatten; the
+# correspondence is pinned by tests/test_tensor.py::test_walk_matches_tables.
+
+
+def template_count(t: ArrayType) -> int:
+    if t.kind == ArrayKind.RANGE_LEN:
+        return max(t.range_begin, 1)
+    return 1
+
+
+def template_arg(t) -> Arg:
+    """Default arg tree with exactly the template's shape."""
+    if isinstance(t, PtrType):
+        return PointerArg(t, 0, 0, 0, template_arg(t.elem))
+    if isinstance(t, VmaType):
+        return PointerArg(t, 0, 0, max(1, t.range_begin), None)
+    if isinstance(t, ArrayType):
+        return GroupArg(t, [template_arg(t.elem)
+                            for _ in range(template_count(t))])
+    if isinstance(t, StructType):
+        return GroupArg(t, [template_arg(f) for f in t.fields])
+    if isinstance(t, UnionType):
+        return UnionArg(t, template_arg(t.fields[0]), t.fields[0])
+    if isinstance(t, BufferType):
+        return DataArg(t, b"")
+    if isinstance(t, ResourceType):
+        return make_result_arg(t, None, t.default())
+    return default_arg(t)
+
+
+def walk_slots(args: List[Arg], budget: Optional[List[int]] = None
+               ) -> Iterator[Tuple[Arg, int]]:
+    """Yield (arg, slot_kind) in template order over a template-shaped tree."""
+    if budget is None:
+        budget = [MAX_SLOTS_PER_CALL]
+
+    def rec(arg: Arg):
+        if budget[0] <= 0:
+            return
+        t = arg.typ
+        if isinstance(t, ResourceType):
+            budget[0] -= 1
+            yield arg, (SK_REF if t.dir == Dir.IN else SK_VALUE)
+        elif isinstance(t, LenType):
+            budget[0] -= 1
+            yield arg, SK_LEN
+        elif isinstance(t, (IntType, FlagsType, ProcType, CsumType)):
+            budget[0] -= 1
+            yield arg, SK_VALUE
+        elif isinstance(t, ConstType):
+            if not is_pad(t):
+                budget[0] -= 1
+                yield arg, SK_VALUE
+        elif isinstance(t, VmaType):
+            budget[0] -= 1
+            yield arg, SK_VMA
+        elif isinstance(t, BufferType):
+            budget[0] -= 1
+            yield arg, SK_DATA
+        elif isinstance(t, PtrType):
+            budget[0] -= 1
+            yield arg, SK_PTR
+            if isinstance(arg, PointerArg) and arg.res is not None:
+                yield from rec(arg.res)
+        elif isinstance(t, StructType):
+            for f in arg.inner:
+                yield from rec(f)
+        elif isinstance(t, UnionType):
+            yield from rec(arg.option)
+        elif isinstance(t, ArrayType):
+            for e in arg.inner:
+                yield from rec(e)
+
+    for a in args:
+        yield from rec(a)
+
+
+def _zip_template(meta, actual_args: List[Arg]) -> List[Arg]:
+    """Build a template-shaped tree taking values from the actual tree where
+    shapes align (lossy projection of a host program onto the template)."""
+
+    def proj(t, a: Optional[Arg]) -> Arg:
+        if a is None or a.typ.__class__ is not t.__class__ \
+                and not isinstance(t, (StructType, UnionType, ArrayType)):
+            pass
+        if isinstance(t, PtrType):
+            res = None
+            if isinstance(a, PointerArg):
+                res = a.res
+            return PointerArg(t, 0, 0, 0, proj(t.elem, res))
+        if isinstance(t, VmaType):
+            npg = a.pages_num if isinstance(a, PointerArg) and a.pages_num \
+                else max(1, t.range_begin)
+            return PointerArg(t, 0, 0, npg, None)
+        if isinstance(t, ArrayType):
+            n = template_count(t)
+            actual = a.inner if isinstance(a, GroupArg) else []
+            return GroupArg(t, [
+                proj(t.elem, actual[i] if i < len(actual) else None)
+                for i in range(n)])
+        if isinstance(t, StructType):
+            actual = a.inner if isinstance(a, GroupArg) else []
+            return GroupArg(t, [
+                proj(f, actual[i] if i < len(actual) else None)
+                for i, f in enumerate(t.fields)])
+        if isinstance(t, UnionType):
+            # template pins option 0
+            opt0 = t.fields[0]
+            if isinstance(a, UnionArg) and \
+                    a.option_type.field_name == opt0.field_name:
+                return UnionArg(t, proj(opt0, a.option), opt0)
+            return UnionArg(t, proj(opt0, None), opt0)
+        if isinstance(t, BufferType):
+            data = a.data if isinstance(a, DataArg) else b""
+            return DataArg(t, data)
+        if isinstance(t, ResourceType):
+            if isinstance(a, ResultArg):
+                na = ResultArg(t, res=a.res, val=a.val, op_div=a.op_div,
+                               op_add=a.op_add)
+                return na
+            return ResultArg(t, None, t.default())
+        if isinstance(t, (IntType, FlagsType, ProcType, LenType, CsumType,
+                          ConstType)):
+            val = a.val if isinstance(a, ConstArg) else t.default()
+            return ConstArg(t, val)
+        return template_arg(t)
+
+    return [proj(t, actual_args[i] if i < len(actual_args) else None)
+            for i, t in enumerate(meta.args)]
+
+
+# ---------------------------------------------------------------------- #
+# Encode: Prog -> tensor row
+
+
+def _producer_index(p: Prog, res: Arg, limit: int) -> int:
+    """Index of the call that produces `res`, or -1."""
+    for i, c in enumerate(p.calls[:limit]):
+        if c.ret is res:
+            return i
+        found = [False]
+
+        def chk(a: Arg, _b):
+            if a is res:
+                found[0] = True
+
+        from .prog import foreach_subarg
+        for a in c.args:
+            foreach_subarg(a, chk)
+        if found[0]:
+            return i
+    return -1
+
+
+def encode_prog(tables: CompiledTables, fmt: TensorFormat, p: Prog,
+                out: Optional[ProgBatch] = None, row: int = 0) -> ProgBatch:
+    if out is None:
+        out = ProgBatch.empty(fmt, 1)
+    call_id = out.call_id[row]
+    slot_val = out.slot_val[row]
+    data = out.data[row]
+    call_id[:] = -1
+    slot_val[:] = 0
+    data[:] = 0
+
+    # skip synthesized mmap preludes: the tensor form re-adds its own
+    calls = [c for c in p.calls if c.meta is not p.target.mmap_syscall]
+
+    for ci, c in enumerate(calls[: fmt.max_calls]):
+        call_id[ci] = c.meta.id
+        proj = _zip_template(c.meta, c.args)
+        off = tables.call_slot_off[c.meta.id]
+        for si, (arg, kind) in enumerate(walk_slots(proj)):
+            if si >= fmt.max_slots:
+                break
+            gk = int(tables.slot_kind[off + si]) if si < int(
+                tables.call_slot_cnt[c.meta.id]) else kind
+            if kind == SK_VALUE:
+                slot_val[ci, si] = np.uint64(arg.val & UINT64_MAX) \
+                    if isinstance(arg, ConstArg) else np.uint64(
+                        getattr(arg, "val", 0) & UINT64_MAX)
+            elif kind == SK_REF:
+                idx = -1
+                if isinstance(arg, ResultArg) and arg.res is not None:
+                    idx = _producer_index(p, arg.res, len(p.calls))
+                    if idx >= 0:
+                        # renumber into the mmap-stripped window
+                        orig = p.calls[idx]
+                        idx = calls.index(orig) if orig in calls else -1
+                if 0 <= idx < fmt.max_calls:
+                    slot_val[ci, si] = np.uint64(idx)
+                else:
+                    slot_val[ci, si] = np.uint64(REF_NONE)
+            elif kind == SK_DATA:
+                cap = int(tables.slot_size[off + si]) \
+                    if si < int(tables.call_slot_cnt[c.meta.id]) else 0
+                payload = arg.data[:cap] if isinstance(arg, DataArg) else b""
+                slot_val[ci, si] = np.uint64(len(payload))
+                blk = int(tables.slot_block[off + si])
+                if blk >= 0 and payload:
+                    base = int(tables.block_addr[
+                        int(tables.call_block_off[c.meta.id]) + blk]) + \
+                        int(tables.slot_offset[off + si])
+                    end = min(base + len(payload), fmt.arena)
+                    if base < fmt.arena:
+                        data[ci, base:end] = np.frombuffer(
+                            payload[: end - base], dtype=np.uint8)
+            elif kind == SK_VMA:
+                npg = arg.pages_num if isinstance(arg, PointerArg) else 1
+                slot_val[ci, si] = np.uint64(max(1, npg))
+            # SK_PTR / SK_LEN: static / recomputed
+    return out
+
+
+# ---------------------------------------------------------------------- #
+# Decode: tensor row -> Prog
+
+
+def decode_prog(tables: CompiledTables, fmt: TensorFormat,
+                batch: ProgBatch, row: int = 0) -> Prog:
+    target = tables.target
+    call_id = batch.call_id[row]
+    slot_val = batch.slot_val[row]
+    data = batch.data[row]
+
+    prog = Prog(target, [])
+    page_cursor = 1  # page 0 reserved
+    vma_cursor = fmt.max_calls + 1  # vma pages allocated after call arenas
+    decoded: List[Call] = []
+
+    for ci in range(fmt.max_calls):
+        cid = int(call_id[ci])
+        if cid < 0:
+            continue
+        meta = target.syscalls[cid]
+        args = [template_arg(t) for t in meta.args]
+        call = Call(meta=meta, args=args,
+                    ret=ReturnArg(meta.ret) if meta.ret is not None
+                    else ReturnArg(None))
+        off = int(tables.call_slot_off[cid])
+        cnt = int(tables.call_slot_cnt[cid])
+        call_page = page_cursor
+        page_cursor += 1
+        bo = int(tables.call_block_off[cid])
+
+        for si, (arg, kind) in enumerate(walk_slots(args)):
+            if si >= min(cnt, fmt.max_slots):
+                break
+            v = int(slot_val[ci, si])
+            if kind == SK_VALUE:
+                if isinstance(arg, ConstArg):
+                    arg.val = v & UINT64_MAX
+                elif isinstance(arg, ResultArg):
+                    arg.val = v & UINT64_MAX
+            elif kind == SK_REF:
+                if v != REF_NONE and v < len(decoded):
+                    src_call = decoded[int(v)]
+                    src = _find_source(src_call, arg.typ, target)
+                    if src is not None:
+                        arg.res = src
+                        arg.val = 0
+                        src.uses.add(arg)
+            elif kind == SK_DATA:
+                cap = int(tables.slot_size[off + si])
+                n = min(v, cap)
+                blk = int(tables.slot_block[off + si])
+                if blk >= 0:
+                    base = int(tables.block_addr[bo + blk]) + \
+                        int(tables.slot_offset[off + si])
+                    arg.data = bytes(data[ci, base:base + n].tobytes())
+                else:
+                    arg.data = b"\x00" * n
+            elif kind == SK_VMA:
+                arg.pages_num = max(1, min(v, 16))
+                arg.page_index = vma_cursor
+                vma_cursor += int(arg.pages_num)
+            elif kind == SK_PTR:
+                blk = int(tables.slot_target_block[off + si])
+                if isinstance(arg, PointerArg) and blk >= 0:
+                    arg.page_index = call_page
+                    arg.page_offset = int(tables.block_addr[bo + blk])
+
+        assign_sizes_call(target, call)
+        target.sanitize_call(call)
+        decoded.append(call)
+        prog.calls.append(call)
+
+    # uber-mmap covering call arenas + vma region
+    if target.mmap_syscall is not None and prog.calls:
+        prog.calls.insert(0, target.make_mmap(0, max(vma_cursor, page_cursor)))
+    return prog
+
+
+def _find_source(call: Call, res_type, target) -> Optional[Arg]:
+    """A resource source inside `call` compatible with res_type."""
+    want = res_type.desc.name
+    if call.ret is not None and isinstance(call.ret.typ, ResourceType):
+        if target.is_compatible_resource(want, call.ret.typ.desc.name):
+            return call.ret
+    found: List[Arg] = []
+
+    from .prog import foreach_subarg
+
+    def chk(a: Arg, _b):
+        if found:
+            return
+        if isinstance(a, ResultArg) and isinstance(a.typ, ResourceType) \
+                and a.typ.dir != Dir.IN \
+                and target.is_compatible_resource(want, a.typ.desc.name):
+            found.append(a)
+
+    for a in call.args:
+        foreach_subarg(a, chk)
+    return found[0] if found else None
+
+
+def encode_batch(tables: CompiledTables, fmt: TensorFormat,
+                 progs: List[Prog]) -> ProgBatch:
+    out = ProgBatch.empty(fmt, len(progs))
+    for i, p in enumerate(progs):
+        encode_prog(tables, fmt, p, out, i)
+    return out
+
+
+def decode_batch(tables: CompiledTables, fmt: TensorFormat,
+                 batch: ProgBatch) -> List[Prog]:
+    return [decode_prog(tables, fmt, batch, i) for i in range(batch.batch)]

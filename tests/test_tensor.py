@@ -1,0 +1,117 @@
+"""Tensor codec tests: template correspondence and round-trips."""
+
+import numpy as np
+import pytest
+
+from syzkaller_tpu.descriptions.tables import get_tables
+from syzkaller_tpu.prog import get_target
+from syzkaller_tpu.prog.encoding import serialize
+from syzkaller_tpu.prog.encodingexec import serialize_for_exec
+from syzkaller_tpu.prog.generation import generate
+from syzkaller_tpu.prog.prio import build_choice_table, calculate_priorities
+from syzkaller_tpu.prog.tensor import (
+    ProgBatch,
+    TensorFormat,
+    decode_batch,
+    decode_prog,
+    encode_batch,
+    encode_prog,
+    template_arg,
+    walk_slots,
+)
+
+
+@pytest.fixture(scope="module")
+def target():
+    return get_target("linux", "amd64")
+
+
+@pytest.fixture(scope="module")
+def tables(target):
+    return get_tables(target)
+
+
+@pytest.fixture(scope="module")
+def fmt(tables):
+    return TensorFormat.for_tables(tables)
+
+
+def test_walk_matches_tables(target, tables):
+    """The python walker must reproduce the compiled template slot kinds for
+    every syscall — this pins the codec to the tables."""
+    for meta in target.syscalls:
+        args = [template_arg(t) for t in meta.args]
+        walked = [k for _a, k in walk_slots(args)]
+        off = int(tables.call_slot_off[meta.id])
+        cnt = int(tables.call_slot_cnt[meta.id])
+        expected = tables.slot_kind[off:off + cnt].tolist()
+        assert walked == expected, (
+            f"{meta.name}: walker kinds {walked} != table {expected}")
+
+
+def test_decode_empty(tables, fmt):
+    b = ProgBatch.empty(fmt, 2)
+    p = decode_prog(tables, fmt, b, 0)
+    assert p.calls == []
+
+
+def test_decode_template_programs(target, tables, fmt):
+    """Decoding a batch with just call ids set must give valid programs."""
+    rng = np.random.RandomState(0)
+    b = ProgBatch.empty(fmt, 8)
+    for i in range(8):
+        n = rng.randint(1, fmt.max_calls)
+        b.call_id[i, :n] = rng.randint(0, tables.n_calls, n)
+    for p in decode_batch(tables, fmt, b):
+        p.validate()
+        serialize(p)
+        serialize_for_exec(p)
+
+
+def test_roundtrip_host_programs(target, tables, fmt):
+    """encode(host prog) -> decode -> must be valid and preserve the call
+    sequence (modulo mmap normalization and template-shape projection)."""
+    ct = build_choice_table(target, calculate_priorities(target, []))
+    for seed in range(20):
+        p = generate(target, seed, 10, ct)
+        b = encode_prog(tables, fmt, p)
+        q = decode_prog(tables, fmt, b, 0)
+        q.validate()
+        serialize_for_exec(q)
+        mmap = target.mmap_syscall
+        orig = [c.meta.name for c in p.calls if c.meta is not mmap]
+        got = [c.meta.name for c in q.calls if c.meta is not mmap]
+        assert got == orig[: fmt.max_calls]
+
+
+def test_encode_decode_encode_stable(target, tables, fmt):
+    """decode -> encode must be a fixed point on the tensor form."""
+    ct = build_choice_table(target, calculate_priorities(target, []))
+    for seed in range(10):
+        p = generate(target, seed, 8, ct)
+        b1 = encode_prog(tables, fmt, p)
+        q = decode_prog(tables, fmt, b1, 0)
+        b2 = encode_prog(tables, fmt, q)
+        assert np.array_equal(b1.call_id, b2.call_id)
+        assert np.array_equal(b1.slot_val, b2.slot_val), (
+            serialize(q),
+            np.argwhere(b1.slot_val != b2.slot_val)[:5],
+        )
+        assert np.array_equal(b1.data, b2.data)
+
+
+def test_refs_preserved(target, tables, fmt):
+    """Cross-call fd dataflow survives the tensor round-trip."""
+    from syzkaller_tpu.prog.encoding import deserialize
+
+    text = ('r0 = open(&0:0:1="./f0\\x00", 0x0, 0x0)\n'
+            'read(r0, &1:0:1=zero(0x10), 0x10)\n'
+            'close(r0)\n')
+    p = deserialize(target, text)
+    b = encode_prog(tables, fmt, p)
+    q = decode_prog(tables, fmt, b, 0)
+    calls = [c for c in q.calls if c.meta is not target.mmap_syscall]
+    read_fd = calls[1].args[0]
+    close_fd = calls[2].args[0]
+    assert read_fd.res is calls[0].ret
+    assert close_fd.res is calls[0].ret
