@@ -4,11 +4,22 @@
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force-override: the environment may pin JAX_PLATFORMS to a hardware
+# backend (e.g. the axon TPU tunnel, whose sitecustomize registers the
+# plugin unconditionally); tests must stay hermetic on the virtual CPU
+# mesh, so update the jax config directly as well.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+try:
+    import jax  # noqa: E402
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:
+    pass  # host-only tests still run; ops tests importorskip jax
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))

@@ -1,0 +1,235 @@
+"""Coverage engine: exact sorted-set algebra + packed-bitset hot path.
+
+Device counterpart of the reference's pkg/cover (reference:
+/root/reference/pkg/cover/cover.go): Canonicalize / Union / Intersection /
+Difference / SymmetricDifference / HasDifference over sorted u32 PC sets,
+SignalNew/Diff/Add against the accumulated max-signal, and greedy set-cover
+corpus minimization.
+
+Two representations:
+  - exact sets: fixed-width sorted u32 arrays padded with SENT (0xffffffff),
+    semantics-identical to the reference (parity-tested against a direct
+    python reimplementation);
+  - packed bitsets: [nbits/32] u32 lanes indexed by the low bits of the
+    signal hash. Signal values are already avalanche-mixed by the executor
+    (edge sig = pc ^ hash(prev)), so low bits are uniform. The fuzzer hot
+    path (is-there-new-signal over thousands of programs) is a gather over
+    the global bitset; merges are scatter-ORs and cross-chip union is a
+    bitwise-OR all-reduce (see parallel/collective.py).
+"""
+
+from __future__ import annotations
+
+from . import ensure_x64  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+SENT = jnp.uint32(0xFFFFFFFF)
+U32 = jnp.uint32
+
+
+# ---------------------------------------------------------------------- #
+# Exact sorted-set representation
+
+
+def canonicalize(x, out_size: int | None = None):
+    """Sort + dedup + pad with SENT."""
+    x = jnp.asarray(x, U32)
+    n = out_size or x.shape[-1]
+    s = jnp.sort(x, axis=-1)
+    dup = jnp.concatenate(
+        [jnp.zeros(s.shape[:-1] + (1,), bool), s[..., 1:] == s[..., :-1]],
+        axis=-1)
+    s = jnp.where(dup, SENT, s)
+    s = jnp.sort(s, axis=-1)
+    if n != s.shape[-1]:
+        pad = jnp.full(s.shape[:-1] + (max(n - s.shape[-1], 0),), SENT, U32)
+        s = jnp.concatenate([s, pad], axis=-1)[..., :n]
+    return s
+
+
+def _member(sorted_set, values):
+    """For each value: is it present in the canonical set? Supports leading
+    batch dimensions on either side (searchsorted needs a 1-D haystack, so
+    batched sets are vmapped)."""
+
+    def one(ss, v):
+        idx = jnp.minimum(jnp.searchsorted(ss, v), ss.shape[-1] - 1)
+        return (ss[idx] == v) & (v != SENT)
+
+    if sorted_set.ndim == 1:
+        return one(sorted_set, values)
+    lead = sorted_set.shape[:-1]
+    ssf = sorted_set.reshape((-1, sorted_set.shape[-1]))
+    vf = jnp.broadcast_to(
+        values, lead + values.shape[-1:]).reshape((-1, values.shape[-1]))
+    return jax.vmap(one)(ssf, vf).reshape(lead + values.shape[-1:])
+
+
+def union(a, b):
+    out = jnp.concatenate([a, b], axis=-1)
+    return canonicalize(out)
+
+
+def intersection(a, b):
+    keep = _member(b, a)
+    return canonicalize(jnp.where(keep, a, SENT))
+
+
+def difference(a, b):
+    keep = ~_member(b, a) & (a != SENT)
+    return canonicalize(jnp.where(keep, a, SENT))
+
+
+def symmetric_difference(a, b):
+    da = jnp.where(~_member(b, a) & (a != SENT), a, SENT)
+    db = jnp.where(~_member(a, b) & (b != SENT), b, SENT)
+    return canonicalize(jnp.concatenate([da, db], axis=-1))
+
+
+def has_difference(a, b):
+    """True if a has coverage not present in b (the fuzzer hot path,
+    cover.go:104-117)."""
+    return jnp.any(~_member(b, a) & (a != SENT), axis=-1)
+
+
+def set_size(a):
+    return jnp.sum(a != SENT, axis=-1)
+
+
+# ---------------------------------------------------------------------- #
+# Packed bitsets
+
+DEFAULT_BITS = 1 << 26  # 64 Mbit = 8 MB per set
+
+
+def make_bitset(nbits: int = DEFAULT_BITS):
+    return jnp.zeros(nbits // 32, dtype=U32)
+
+
+def _index(bitset, sigs):
+    nbits = bitset.shape[-1] * 32
+    h = jnp.asarray(sigs, U32) & U32(nbits - 1)
+    return h >> 5, (h & U32(31)).astype(U32)
+
+
+def bitset_test(bitset, sigs):
+    """Gather: which signals are already present? (masked for SENT)"""
+    word, bit = _index(bitset, sigs)
+    hit = (bitset[word] >> bit) & U32(1)
+    return (hit == 1) & (jnp.asarray(sigs, U32) != SENT)
+
+
+def bitset_add(bitset, sigs):
+    """Scatter-OR signals into the set (SENT lanes are no-ops)."""
+    word, bit = _index(bitset, sigs)
+    mask = jnp.where(jnp.asarray(sigs, U32) == SENT, U32(0),
+                     U32(1) << bit)
+    # scatter with bitwise-or accumulation over duplicate words
+    return jnp.bitwise_or.at(bitset, word, mask, inplace=False)
+
+
+def bitset_count(bitset):
+    return jnp.sum(jax.lax.population_count(bitset))
+
+
+def bitset_or(a, b):
+    return a | b
+
+
+def signal_new(max_signal_bits, sigs):
+    """Per batch row: any signal not yet in the accumulated set?
+    sigs: [..., S] u32 padded with SENT."""
+    fresh = ~bitset_test(max_signal_bits, sigs) & \
+        (jnp.asarray(sigs, U32) != SENT)
+    return jnp.any(fresh, axis=-1)
+
+
+def signal_diff_mask(max_signal_bits, sigs):
+    """Boolean mask of the signals that are new."""
+    return ~bitset_test(max_signal_bits, sigs) & \
+        (jnp.asarray(sigs, U32) != SENT)
+
+
+def signal_add(max_signal_bits, sigs):
+    return bitset_add(max_signal_bits, jnp.asarray(sigs, U32).reshape(-1))
+
+
+# ---------------------------------------------------------------------- #
+# Corpus minimization: greedy set cover (cover.go:119-146), device version
+# over per-program bitsets.
+
+
+def minimize_corpus(program_bits, sizes=None):
+    """program_bits: [N, L] u32 packed coverage per program.
+    Returns keep mask [N] bool — the greedy cover: programs in decreasing
+    coverage-size order, kept iff they add an uncovered bit."""
+    program_bits = jnp.asarray(program_bits)
+    n = program_bits.shape[0]
+    if sizes is None:
+        sizes = jax.vmap(bitset_count)(program_bits)
+    order = jnp.argsort(-sizes)
+
+    def step(covered, i):
+        bits = program_bits[i]
+        newbits = bits & ~covered
+        hit = jnp.any(newbits != 0)
+        covered = jnp.where(hit, covered | bits, covered)
+        return covered, hit
+
+    covered0 = jnp.zeros_like(program_bits[0])
+    _, hits = jax.lax.scan(step, covered0, order)
+    keep = jnp.zeros(n, dtype=bool).at[order].set(hits)
+    return keep
+
+
+# ---------------------------------------------------------------------- #
+# Host-side exact reference (used by parity tests and host corpus records)
+
+
+def py_canonicalize(cov):
+    return sorted(set(int(x) for x in cov))
+
+
+def py_union(a, b):
+    return sorted(set(a) | set(b))
+
+
+def py_intersection(a, b):
+    return sorted(set(a) & set(b))
+
+
+def py_difference(a, b):
+    return sorted(set(a) - set(b))
+
+
+def py_symmetric_difference(a, b):
+    return sorted(set(a) ^ set(b))
+
+
+def py_has_difference(a, b):
+    return bool(set(a) - set(b))
+
+
+def py_minimize(corpus):
+    """Greedy set cover over exact sets; returns kept indices
+    (cover.go:119-146 semantics: larger covers first, keep if any new)."""
+    order = sorted(range(len(corpus)), key=lambda i: -len(corpus[i]))
+    covered: set = set()
+    keep = []
+    for i in order:
+        cov = set(corpus[i])
+        if cov - covered:
+            keep.append(i)
+            covered |= cov
+    return sorted(keep)
+
+
+def pad_set(values, size: int) -> np.ndarray:
+    """Host helper: exact set -> padded sorted u32 array."""
+    vals = sorted(set(int(v) & 0xFFFFFFFF for v in values))[:size]
+    out = np.full(size, 0xFFFFFFFF, dtype=np.uint32)
+    out[: len(vals)] = np.array(vals, dtype=np.uint32)
+    return out

@@ -1,0 +1,204 @@
+"""Device-resident table views: dense [n_calls, S] gathers of the slot
+templates plus precomputed default program images.
+
+The ragged slot templates from descriptions/tables.py are densified so that
+`call_id` alone indexes every per-slot property — the shape the vmapped
+mutation/generation kernels need (one gather per property instead of a tree
+walk; reference equivalent is the generated Go type graph walked per arg).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from . import ensure_x64  # noqa: F401
+
+import jax.numpy as jnp
+
+from ..descriptions.tables import (
+    SK_DATA,
+    SK_LEN,
+    SK_PTR,
+    SK_REF,
+    SK_VALUE,
+    SK_VMA,
+    TK_BUF_BLOB,
+    TK_BUF_FILE,
+    TK_BUF_STR,
+    TK_BUF_TEXT,
+    TK_CONST,
+    TK_CSUM,
+    TK_FLAGS,
+    TK_INT,
+    TK_LEN,
+    TK_PROC,
+    TK_RES,
+    TK_VMA,
+    CompiledTables,
+)
+from ..prog.tensor import REF_NONE, TensorFormat
+
+DEFAULT_FILENAME = b"./file0\x00"
+
+
+@dataclass
+class DeviceTables:
+    """Registered as a jax pytree: array fields are leaves, the four size
+    fields are static metadata (so jitted kernels specialize on shapes)."""
+
+    n_calls: int
+    n_res: int
+    max_slots: int
+    arena: int
+
+    # dense per-(call, slot) properties
+    slot_kind: jnp.ndarray      # [N, S] i32 (-1 pad)
+    slot_tkind: jnp.ndarray     # [N, S] i32 type kind (-1 pad)
+    slot_size: jnp.ndarray      # [N, S] i32 byte width / data cap
+    slot_lo: jnp.ndarray        # [N, S] u64
+    slot_hi: jnp.ndarray        # [N, S] u64
+    slot_flags_off: jnp.ndarray
+    slot_flags_cnt: jnp.ndarray
+    slot_res_kind: jnp.ndarray  # [N, S] i32
+    slot_arena_off: jnp.ndarray  # [N, S] i32 (-1 if not a data slot)
+    slot_cnt: jnp.ndarray       # [N] i32
+
+    # defaults for insertion/generation
+    default_slot_val: jnp.ndarray  # [N, S] u64
+    default_arena: jnp.ndarray     # [N, D] u8
+
+    flags_pool: jnp.ndarray        # [F] u64
+    produces_compat: jnp.ndarray   # [N, R] u8: call produces kind compatible
+    needs: jnp.ndarray             # [N, R] u8
+    choice_run: jnp.ndarray        # [N, N] i64 cumulative weights
+    enabled: jnp.ndarray           # [N] bool
+    enabled_run: jnp.ndarray       # [N] i64 cumsum of enabled (uniform pick)
+
+    str_data: jnp.ndarray          # [NS, cap] u8
+    str_len: jnp.ndarray           # [NS] i32
+
+
+import jax
+
+jax.tree_util.register_dataclass(
+    DeviceTables,
+    data_fields=[
+        "slot_kind", "slot_tkind", "slot_size", "slot_lo", "slot_hi",
+        "slot_flags_off", "slot_flags_cnt", "slot_res_kind",
+        "slot_arena_off", "slot_cnt", "default_slot_val", "default_arena",
+        "flags_pool", "produces_compat", "needs", "choice_run", "enabled",
+        "enabled_run", "str_data", "str_len",
+    ],
+    meta_fields=["n_calls", "n_res", "max_slots", "arena"],
+)
+
+
+def build_device_tables(ct: CompiledTables, fmt: TensorFormat,
+                        prios: Optional[np.ndarray] = None,
+                        enabled_mask: Optional[np.ndarray] = None
+                        ) -> DeviceTables:
+    n, S, D = ct.n_calls, fmt.max_slots, fmt.arena
+    R = max(ct.n_res_kinds, 1)
+
+    kind = np.full((n, S), -1, dtype=np.int32)
+    tkind = np.full((n, S), -1, dtype=np.int32)
+    size = np.zeros((n, S), dtype=np.int32)
+    lo = np.zeros((n, S), dtype=np.uint64)
+    hi = np.zeros((n, S), dtype=np.uint64)
+    foff = np.zeros((n, S), dtype=np.int32)
+    fcnt = np.zeros((n, S), dtype=np.int32)
+    resk = np.full((n, S), -1, dtype=np.int32)
+    aoff = np.full((n, S), -1, dtype=np.int32)
+    dval = np.zeros((n, S), dtype=np.uint64)
+    darena = np.zeros((n, D), dtype=np.uint8)
+
+    for ci in range(n):
+        o = int(ct.call_slot_off[ci])
+        cnt = min(int(ct.call_slot_cnt[ci]), S)
+        bo = int(ct.call_block_off[ci])
+        for si in range(cnt):
+            g = o + si
+            ti = int(ct.slot_type[g])
+            sk = int(ct.slot_kind[g])
+            kind[ci, si] = sk
+            tkind[ci, si] = int(ct.type_kind[ti])
+            size[ci, si] = int(ct.slot_size[g])
+            lo[ci, si] = ct.type_lo[ti]
+            hi[ci, si] = ct.type_hi[ti]
+            foff[ci, si] = int(ct.type_flags_off[ti])
+            fcnt[ci, si] = int(ct.type_flags_cnt[ti])
+            resk[ci, si] = int(ct.slot_res_kind[g])
+            blk = int(ct.slot_block[g])
+            if sk == SK_DATA and blk >= 0:
+                aoff[ci, si] = int(ct.block_addr[bo + blk]) + \
+                    int(ct.slot_offset[g])
+
+            # defaults
+            if sk == SK_VALUE:
+                dval[ci, si] = ct.slot_default[g]
+            elif sk == SK_REF:
+                dval[ci, si] = np.uint64(REF_NONE)
+            elif sk == SK_VMA:
+                dval[ci, si] = max(1, int(ct.slot_default[g]))
+            elif sk == SK_DATA:
+                tk = int(ct.type_kind[ti])
+                payload = b""
+                if tk == TK_BUF_STR and int(ct.slot_str_cnt[g]) > 0:
+                    so = int(ct.slot_str_off[g])
+                    ln = int(ct.str_len[so])
+                    payload = bytes(ct.str_data[so, :ln].tobytes())
+                elif tk == TK_BUF_FILE:
+                    payload = DEFAULT_FILENAME
+                elif tk == TK_BUF_BLOB:
+                    payload = b"\x00" * min(int(ct.type_lo[ti]),
+                                            size[ci, si])
+                payload = payload[: size[ci, si]]
+                dval[ci, si] = len(payload)
+                a = aoff[ci, si]
+                if a >= 0 and payload:
+                    end = min(a + len(payload), D)
+                    darena[ci, a:end] = np.frombuffer(
+                        payload[: end - a], dtype=np.uint8)
+
+    # produces_compat[call, want_kind]: call yields a resource usable as want
+    produces = ct.call_res_out.astype(np.uint8)  # [N, R]
+    compat = ct.res_compat.astype(np.uint8)      # [dst, src]
+    produces_compat = (produces @ compat.T > 0).astype(np.uint8) \
+        if ct.n_res_kinds else np.zeros((n, R), dtype=np.uint8)
+
+    if prios is None:
+        prios = ct.prio_static
+    if enabled_mask is None:
+        enabled_mask = np.ones(n, dtype=bool)
+    weights = (prios * 1000).astype(np.int64) * enabled_mask[None, :]
+    run = np.cumsum(weights, axis=1)
+
+    return DeviceTables(
+        n_calls=n, n_res=R, max_slots=S, arena=D,
+        slot_kind=jnp.asarray(kind),
+        slot_tkind=jnp.asarray(tkind),
+        slot_size=jnp.asarray(size),
+        slot_lo=jnp.asarray(lo),
+        slot_hi=jnp.asarray(hi),
+        slot_flags_off=jnp.asarray(foff),
+        slot_flags_cnt=jnp.asarray(fcnt),
+        slot_res_kind=jnp.asarray(resk),
+        slot_arena_off=jnp.asarray(aoff),
+        slot_cnt=jnp.asarray(np.minimum(ct.call_slot_cnt, S)),
+        default_slot_val=jnp.asarray(dval),
+        default_arena=jnp.asarray(darena),
+        flags_pool=jnp.asarray(ct.flags_pool),
+        produces_compat=jnp.asarray(produces_compat),
+        needs=jnp.asarray(
+            ct.call_res_in.astype(np.uint8) if ct.n_res_kinds
+            else np.zeros((n, R), dtype=np.uint8)),
+        choice_run=jnp.asarray(run),
+        enabled=jnp.asarray(enabled_mask),
+        enabled_run=jnp.asarray(
+            np.cumsum(enabled_mask.astype(np.int64))),
+        str_data=jnp.asarray(ct.str_data),
+        str_len=jnp.asarray(ct.str_len),
+    )

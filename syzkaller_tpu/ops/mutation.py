@@ -1,0 +1,425 @@
+"""Batched device mutation + generation over program tensors.
+
+The TPU replacement for the reference's per-program tree mutator
+(reference: /root/reference/prog/mutation.go:12-250) and generator
+(prog/generation.go, prog/rand.go:440-476): one vmapped kernel applies a
+weighted mix of
+  - corpus splice        (donor program prefix, reference 1/100)
+  - call insertion       (tail-biased position, choice-table weighted
+                          syscall, template defaults + sampled values,
+                          resource refs resolved to the latest compatible
+                          producing call)
+  - value mutation       (+-delta / bitflip / type-directed resample)
+  - data mutation        (byte ops + length changes inside the call arena)
+  - call removal         (with REF index remapping)
+to every program lane in parallel. Slot semantics (which slots are values /
+refs / data) come from the dense device tables; nothing walks a tree.
+
+LEN slots are not maintained on device: the host decode path recomputes
+them (assign_sizes_call) before execution, mirroring the reference's
+assignSizesCall-after-mutation.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+from . import ensure_x64  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+
+from ..descriptions.tables import (
+    SK_DATA,
+    SK_REF,
+    SK_VALUE,
+    TK_FLAGS,
+    TK_INT,
+    TK_PROC,
+)
+from ..prog.tensor import REF_NONE
+from .dtables import DeviceTables
+from .rng import (
+    biased_rand,
+    choose_weighted,
+    pick_masked,
+    rand_int,
+    rand_range_int,
+    rand_u64,
+    sample_flags,
+)
+
+U64 = jnp.uint64
+Row = Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]  # cid [C], sval [C,S], data [C,D]
+
+REF_NONE_U = U64(REF_NONE)
+
+
+def _safe(cid):
+    return jnp.maximum(cid, 0)
+
+
+def _live(cid):
+    return cid >= 0
+
+
+def _slot_index_mask(dt: DeviceTables, cid):
+    """[C, S] mask of slots that exist for each live call."""
+    S = dt.max_slots
+    scnt = dt.slot_cnt[_safe(cid)]
+    return _live(cid)[:, None] & (jnp.arange(S)[None, :] < scnt[:, None])
+
+
+# ---------------------------------------------------------------------- #
+# value mutation
+
+
+def value_mutate(key, dt: DeviceTables, row: Row) -> Row:
+    cid, sval, data = row
+    kpick, kop, kd, kb, kr = jax.random.split(key, 5)
+    sc = _safe(cid)
+    kind = dt.slot_kind[sc]
+    tk = dt.slot_tkind[sc]
+    mutable = _slot_index_mask(dt, cid) & (kind == SK_VALUE) & (
+        (tk == TK_INT) | (tk == TK_FLAGS) | (tk == TK_PROC))
+    flat = pick_masked(kpick, mutable.reshape(-1))
+    ok = flat >= 0
+    flat_s = jnp.maximum(flat, 0)
+    c, s = flat_s // dt.max_slots, flat_s % dt.max_slots
+
+    cur = sval[c, s]
+    size = dt.slot_size[sc][c, s]
+    bits = jnp.maximum(size * 8, 1).astype(U64)
+    vmask = jnp.where(size >= 8, U64(0xFFFFFFFFFFFFFFFF),
+                      (U64(1) << bits) - U64(1))
+
+    delta = (rand_u64(kd) % U64(4)) + U64(1)
+    bit = rand_u64(kb) % bits
+    this_tk = tk[c, s]
+    lo, hi = dt.slot_lo[sc][c, s], dt.slot_hi[sc][c, s]
+    resample_int = jnp.where(lo < hi, rand_range_int(kr, lo, hi),
+                             rand_int(kr))
+    resample_flags = sample_flags(kr, dt.slot_flags_off[sc][c, s],
+                                  dt.slot_flags_cnt[sc][c, s], dt.flags_pool)
+    resample_proc = rand_u64(kr) % jnp.maximum(hi, U64(1))
+    resample = jnp.select(
+        [this_tk == TK_FLAGS, this_tk == TK_PROC],
+        [resample_flags, resample_proc], resample_int)
+
+    op = jax.random.randint(kop, (), 0, 4)
+    nv = jnp.select(
+        [op == 0, op == 1, op == 2],
+        [cur + delta, cur - delta, cur ^ (U64(1) << bit)],
+        resample) & vmask
+    sval = sval.at[c, s].set(jnp.where(ok, nv, cur))
+    return cid, sval, data
+
+
+# ---------------------------------------------------------------------- #
+# data (byte-arena) mutation
+
+
+def data_mutate(key, dt: DeviceTables, row: Row) -> Row:
+    cid, sval, data = row
+    kpick, kop, kpos, kval, klen = jax.random.split(key, 5)
+    sc = _safe(cid)
+    kind = dt.slot_kind[sc]
+    mutable = _slot_index_mask(dt, cid) & (kind == SK_DATA)
+    flat = pick_masked(kpick, mutable.reshape(-1))
+    ok = flat >= 0
+    flat_s = jnp.maximum(flat, 0)
+    c, s = flat_s // dt.max_slots, flat_s % dt.max_slots
+
+    aoff = dt.slot_arena_off[sc][c, s]
+    cap = dt.slot_size[sc][c, s]
+    lo = dt.slot_lo[sc][c, s].astype(jnp.int32)
+    ln = jnp.minimum(sval[c, s].astype(jnp.int32), cap)
+
+    op = jax.random.randint(kop, (), 0, 6)
+    pos = aoff + (jax.random.randint(kpos, (), 0, 1 << 30)
+                  % jnp.maximum(ln, 1))
+    pos = jnp.clip(pos, 0, dt.arena - 1)
+    cur_byte = data[c, pos].astype(jnp.int32)
+    rb = (rand_u64(kval) % U64(256)).astype(jnp.int32)
+    interesting = (rand_int(kval) & U64(0xFF)).astype(jnp.int32)
+    delta = (jax.random.randint(kval, (), -35, 36) | 1)
+    new_byte = jnp.select(
+        [op == 0, op == 1, op == 2, op == 3],
+        [rb,
+         cur_byte ^ (1 << jax.random.randint(kpos, (), 0, 8)),
+         interesting,
+         (cur_byte + delta) & 0xFF],
+        cur_byte) & 0xFF
+    byte_ok = ok & (op < 4) & (ln > 0) & (aoff >= 0)
+    data = data.at[c, pos].set(
+        jnp.where(byte_ok, new_byte, cur_byte).astype(jnp.uint8))
+
+    grow = jnp.minimum(ln + 1 + jax.random.randint(klen, (), 0, 8), cap)
+    shrink = jnp.maximum(ln - 1 - jax.random.randint(klen, (), 0, 8), lo)
+    new_len = jnp.select([op == 4, op == 5], [grow, shrink], ln)
+    new_len = jnp.clip(new_len, jnp.minimum(lo, cap), cap)
+    sval = sval.at[c, s].set(
+        jnp.where(ok, new_len.astype(U64), sval[c, s]))
+    return cid, sval, data
+
+
+# ---------------------------------------------------------------------- #
+# call removal
+
+
+def _fix_refs_after_remove(dt, cid, sval, removed):
+    sc = _safe(cid)
+    is_ref = (dt.slot_kind[sc] == SK_REF) & _slot_index_mask(dt, cid)
+    v = sval
+    removed_u = removed.astype(U64)
+    v2 = jnp.where(v == removed_u, REF_NONE_U,
+                   jnp.where((v != REF_NONE_U) & (v > removed_u),
+                             v - U64(1), v))
+    return jnp.where(is_ref, v2, v)
+
+
+def remove_call(key, dt: DeviceTables, row: Row) -> Row:
+    cid, sval, data = row
+    C = cid.shape[0]
+    nlive = jnp.sum(_live(cid))
+    ok = nlive > 0
+    c = jax.random.randint(key, (), 0, jnp.maximum(nlive, 1))
+    idxs = jnp.where(jnp.arange(C) >= c, jnp.arange(C) + 1, jnp.arange(C))
+    idxs = jnp.minimum(idxs, C - 1)
+    new_cid = jnp.where(jnp.arange(C) == C - 1, -1, cid[idxs])
+    new_sval = sval[idxs]
+    new_data = data[idxs]
+    new_sval = _fix_refs_after_remove(dt, new_cid, new_sval, c)
+    return (jnp.where(ok, new_cid, cid),
+            jnp.where(ok, new_sval, sval),
+            jnp.where(ok, new_data, data))
+
+
+# ---------------------------------------------------------------------- #
+# call insertion (also the generation primitive)
+
+
+def _new_call_row(key, dt: DeviceTables, new_id, cid, pos):
+    """Template defaults + sampled values + resolved refs for one new call."""
+    sval = _sample_values(key, dt, new_id)
+    arena = dt.default_arena[new_id]
+    kind = dt.slot_kind[new_id]
+
+    # resolve resource refs: latest earlier live call producing a
+    # compatible kind
+    C = cid.shape[0]
+    want = dt.slot_res_kind[new_id]                      # [S]
+    prod = dt.produces_compat[_safe(cid)]                # [C, R]
+    avail = prod[:, jnp.maximum(want, 0)] > 0            # [C, S]
+    earlier = (_live(cid) & (jnp.arange(C) < pos))[:, None]
+    cand = jnp.where(avail & earlier, jnp.arange(C)[:, None], -1)
+    latest = cand.max(axis=0)                            # [S]
+    ref_val = jnp.where(latest >= 0, latest.astype(U64), REF_NONE_U)
+    sval = jnp.where((kind == SK_REF) & (want >= 0), ref_val, sval)
+    return sval, arena
+
+
+def insert_call(key, dt: DeviceTables, row: Row, pos=None, new_id=None) -> Row:
+    cid, sval, data = row
+    C = cid.shape[0]
+    kpos, kbias, kpick, kchoose, krow = jax.random.split(key, 5)
+    nlive = jnp.sum(_live(cid))
+    ok = nlive < C
+    if pos is None:
+        pos = biased_rand(kpos, nlive + 1, 5)
+    pos = jnp.asarray(pos, jnp.int32)
+
+    if new_id is None:
+        # bias toward a random existing call's row of the choice table
+        bias_idx = jax.random.randint(kbias, (), 0, jnp.maximum(nlive, 1))
+        bias_call = cid[jnp.minimum(bias_idx, C - 1)]
+        have_bias = (nlive > 0) & (bias_call >= 0)
+        row_w = dt.choice_run[_safe(bias_call)]
+        weighted = choose_weighted(kchoose, row_w)
+        uniform = choose_weighted(kpick, dt.enabled_run)
+        new_id = jnp.where(have_bias & (row_w[-1] > 0), weighted, uniform)
+    new_id = jnp.asarray(new_id, jnp.int32)
+
+    new_sval_row, new_data_row = _new_call_row(krow, dt, new_id, cid, pos)
+
+    # shift right at pos
+    ar = jnp.arange(C)
+    src = jnp.maximum(ar - 1, 0)
+    shifted_cid = jnp.where(ar > pos, cid[src], cid)
+    shifted_cid = jnp.where(ar == pos, new_id, shifted_cid)
+    shifted_sval = jnp.where((ar > pos)[:, None], sval[src], sval)
+    shifted_sval = jnp.where((ar == pos)[:, None], new_sval_row, shifted_sval)
+    shifted_data = jnp.where((ar > pos)[:, None], data[src], data)
+    shifted_data = jnp.where((ar == pos)[:, None], new_data_row, shifted_data)
+
+    # refs pointing at calls >= pos move up by one (dropped off the end ->
+    # REF_NONE); the new call's own refs were built post-shift already
+    sc = _safe(shifted_cid)
+    is_ref = (dt.slot_kind[sc] == SK_REF) & _slot_index_mask(dt, shifted_cid)
+    is_new_row = (ar == pos)[:, None]
+    v = shifted_sval
+    moved = jnp.where((v != REF_NONE_U) & (v >= pos.astype(U64)),
+                      v + U64(1), v)
+    moved = jnp.where(moved >= U64(C), REF_NONE_U, moved)
+    fixed = jnp.where(is_ref & ~is_new_row, moved, v)
+    shifted_sval = fixed
+
+    return (jnp.where(ok, shifted_cid, cid),
+            jnp.where(ok, shifted_sval, sval),
+            jnp.where(ok, shifted_data, data))
+
+
+# ---------------------------------------------------------------------- #
+# corpus splice
+
+
+def splice(key, dt: DeviceTables, row: Row, donor: Row) -> Row:
+    cid, sval, data = row
+    dcid, dsval, ddata = donor
+    C = cid.shape[0]
+    k = 1 + jax.random.randint(key, (), 0, C // 2)
+    ar = jnp.arange(C)
+    take_donor = (ar < k) & (dcid >= 0)
+    src_own = jnp.maximum(ar - k, 0)
+    new_cid = jnp.where(take_donor, dcid, cid[src_own])
+    new_cid = jnp.where(~take_donor & (ar < k), -1, new_cid)
+    new_sval = jnp.where(take_donor[:, None], dsval, sval[src_own])
+    new_data = jnp.where(take_donor[:, None], ddata, data[src_own])
+
+    # donor refs into beyond-prefix calls are dangling; own refs shift by k
+    sc = _safe(new_cid)
+    is_ref = (dt.slot_kind[sc] == SK_REF) & _slot_index_mask(dt, new_cid)
+    v = new_sval
+    donor_v = jnp.where((v != REF_NONE_U) & (v >= k.astype(U64)),
+                        REF_NONE_U, v)
+    own_v = jnp.where(v != REF_NONE_U, v + k.astype(U64), v)
+    own_v = jnp.where(own_v >= U64(C), REF_NONE_U, own_v)
+    fixed = jnp.where(take_donor[:, None], donor_v, own_v)
+    new_sval = jnp.where(is_ref, fixed, new_sval)
+    return new_cid, new_sval, new_data
+
+
+# ---------------------------------------------------------------------- #
+# top-level mutate / generate
+
+
+def mutate_program(key, dt: DeviceTables, row: Row, donor: Row,
+                   rounds: int = 2) -> Row:
+    """Apply `rounds` weighted mutation ops to one program lane."""
+
+    def one(i, carry):
+        row, key = carry
+        key, kop, kapply = jax.random.split(key, 3)
+        # weights ~ reference mix: splice 1, insert 44, value 35, data 10,
+        # remove 10 (out of 100)
+        r = jax.random.randint(kop, (), 0, 100)
+        op = jnp.select([r < 1, r < 45, r < 80, r < 90],
+                        [0, 1, 2, 3], 4)
+        row = jax.lax.switch(
+            op,
+            [lambda a: splice(kapply, dt, a, donor),
+             lambda a: insert_call(kapply, dt, a),
+             lambda a: value_mutate(kapply, dt, a),
+             lambda a: data_mutate(kapply, dt, a),
+             lambda a: remove_call(kapply, dt, a)],
+            row)
+        return row, key
+
+    row, _ = jax.lax.fori_loop(0, rounds, one, (row, key))
+    return row
+
+
+@partial(jax.jit, static_argnames=("rounds",))
+def mutate_batch(key, dt: DeviceTables, call_id, slot_val, data,
+                 rounds: int = 2):
+    """Vmapped batch mutation; donors are the batch rolled by one."""
+    B = call_id.shape[0]
+    keys = jax.random.split(key, B)
+    donor = (jnp.roll(call_id, 1, axis=0),
+             jnp.roll(slot_val, 1, axis=0),
+             jnp.roll(data, 1, axis=0))
+
+    def per(key, cid, sval, dat, dcid, dsval, ddat):
+        return mutate_program(key, dt, (cid, sval, dat),
+                              (dcid, dsval, ddat), rounds)
+
+    return jax.vmap(per)(keys, call_id, slot_val, data, *donor)
+
+
+def _sample_values(key, dt: DeviceTables, ids):
+    """Sampled slot values for calls `ids` (any leading shape + [S])."""
+    kv, kf, kp = jax.random.split(key, 3)
+    shape = ids.shape + (dt.max_slots,)
+    tk = dt.slot_tkind[ids]
+    lo, hi = dt.slot_lo[ids], dt.slot_hi[ids]
+    ints = jnp.where(lo < hi, rand_range_int(kv, lo, hi, shape),
+                     rand_int(kv, shape))
+    flags = sample_flags(kf, dt.slot_flags_off[ids], dt.slot_flags_cnt[ids],
+                         dt.flags_pool, shape)
+    procs = rand_u64(kp, shape) % jnp.maximum(hi, U64(1))
+    sampled = jnp.select([tk == TK_FLAGS, tk == TK_PROC], [flags, procs],
+                         ints)
+    size = dt.slot_size[ids]
+    bits = jnp.maximum(size * 8, 1).astype(U64)
+    vmask = jnp.where(size >= 8, U64(0xFFFFFFFFFFFFFFFF),
+                      (U64(1) << bits) - U64(1))
+    is_value = (dt.slot_kind[ids] == SK_VALUE) & (
+        (tk == TK_INT) | (tk == TK_FLAGS) | (tk == TK_PROC))
+    return jnp.where(is_value, sampled & vmask, dt.default_slot_val[ids])
+
+
+def generate_program(key, dt: DeviceTables, C: int, ncalls) -> Row:
+    """One program: sequential choice-table id chain + vectorized rows.
+
+    Call ids follow the reference's biased walk (each call chosen from the
+    previous call's priority row); values are template defaults + sampled;
+    resource refs point at the most recent earlier compatible producer."""
+    kid, ku, kv = jax.random.split(key, 3)
+
+    # --- id chain: scan over C ---
+    def id_step(prev_id, ks):
+        k1, k2 = ks
+        row = dt.choice_run[_safe(prev_id)]
+        weighted = choose_weighted(k1, row)
+        uniform = choose_weighted(k2, dt.enabled_run)  # enabled calls only
+        nid = jnp.where((prev_id >= 0) & (row[-1] > 0), weighted,
+                        uniform).astype(jnp.int32)
+        return nid, nid
+
+    keys = jax.random.split(kid, 2 * C).reshape(C, 2, -1)
+    _, ids = jax.lax.scan(id_step, jnp.int32(-1),
+                          (keys[:, 0], keys[:, 1]))
+    ids = jnp.asarray(ids, jnp.int32)
+    cid = jnp.where(jnp.arange(C) < ncalls, ids, -1)
+    sids = _safe(cid)
+
+    # --- values ---
+    sval = _sample_values(kv, dt, sids)
+    data = dt.default_arena[sids]
+
+    # --- resource refs: last earlier producer per kind (running max) ---
+    prod = (dt.produces_compat[sids] > 0) & _live(cid)[:, None]  # [C, R]
+    idx = jnp.where(prod, jnp.arange(C)[:, None], -1)
+    # last_before[c, k] = max_{j < c} idx[j, k]
+    run_max = jax.lax.associative_scan(jnp.maximum, idx, axis=0)
+    last_before = jnp.concatenate(
+        [jnp.full((1, idx.shape[1]), -1, idx.dtype), run_max[:-1]], axis=0)
+    want = dt.slot_res_kind[sids]                       # [C, S]
+    ref = jnp.take_along_axis(last_before, jnp.maximum(want, 0),
+                              axis=1)                   # [C, S]
+    ref_val = jnp.where(ref >= 0, ref.astype(U64), REF_NONE_U)
+    is_ref = (dt.slot_kind[sids] == SK_REF) & (want >= 0)
+    sval = jnp.where(is_ref, ref_val, sval)
+
+    sval = jnp.where(_live(cid)[:, None], sval, U64(0))
+    data = jnp.where(_live(cid)[:, None], data, jnp.uint8(0))
+    return cid, sval, data
+
+
+@partial(jax.jit, static_argnames=("B", "C"))
+def generate_batch(key, dt: DeviceTables, *, B: int, C: int):
+    kn, kg = jax.random.split(key)
+    ncalls = 1 + jax.random.randint(kn, (B,), 0, C)
+    keys = jax.random.split(kg, B)
+    return jax.vmap(lambda k, n: generate_program(k, dt, C, n))(keys, ncalls)

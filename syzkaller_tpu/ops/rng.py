@@ -1,0 +1,118 @@
+"""Vectorized random-value samplers (threefry lanes).
+
+Device counterparts of the reference's scalar randGen (reference:
+/root/reference/prog/rand.go:59-154): the magnitude-biased interesting-int
+distribution, the special-values table, quadratic biased choice, and flag
+combination sampling — all as shape-polymorphic jax functions suitable for
+vmap over thousands of program lanes.
+
+The category chains below reproduce the reference's nested nOutOf(...)
+conditionals as single uniform draws with cumulative thresholds (a chain
+of conditional n/m branches over disjoint remainders is one categorical).
+"""
+
+from __future__ import annotations
+
+from . import ensure_x64  # noqa: F401  (x64 side effect)
+
+import jax
+import jax.numpy as jnp
+
+SPECIAL_INTS = jnp.array(
+    [0, 1, 31, 32, 63, 64, 127, 128, 129, 255, 256, 257, 511, 512,
+     1023, 1024, 1025, 2047, 2048, 4095, 4096,
+     (1 << 15) - 1, 1 << 15, (1 << 15) + 1,
+     (1 << 16) - 1, 1 << 16, (1 << 16) + 1,
+     (1 << 31) - 1, 1 << 31, (1 << 31) + 1,
+     (1 << 32) - 1, 1 << 32, (1 << 32) + 1],
+    dtype=jnp.uint64,
+)
+
+
+def rand_u64(key, shape=()):
+    return jax.random.bits(key, shape, dtype=jnp.uint64)
+
+
+def rand_int(key, shape=()):
+    """Magnitude-biased interesting integers (rand.go:69-93)."""
+    k1, k2, k3, k4, k5 = jax.random.split(key, 5)
+    v = rand_u64(k1, shape)
+    cat = jax.random.randint(k2, shape, 0, 182)
+    special = SPECIAL_INTS[jax.random.randint(
+        k3, shape, 0, SPECIAL_INTS.shape[0])]
+    v = jnp.select(
+        [cat < 100, cat < 150, cat < 160, cat < 170, cat < 180],
+        [v % 10, special, v % 256, v % (4 << 10), v % (64 << 10)],
+        v % (1 << 31),
+    )
+    cat2 = jax.random.randint(k4, shape, 0, 107)
+    shift = jax.random.randint(k5, shape, 0, 63).astype(jnp.uint64)
+    v = jnp.select(
+        [cat2 < 100, cat2 < 105],
+        [v, (-v.astype(jnp.int64)).astype(jnp.uint64)],
+        v << shift,
+    )
+    return v
+
+
+def rand_range_int(key, lo, hi, shape=()):
+    """Uniform in [lo, hi] with a 1/100 escape to rand_int (rand.go:95-100)."""
+    k1, k2, k3 = jax.random.split(key, 3)
+    lo = jnp.asarray(lo, jnp.uint64)
+    hi = jnp.asarray(hi, jnp.uint64)
+    span = jnp.maximum(hi - lo + 1, 1)
+    u = rand_u64(k1, shape) % span + lo
+    esc = jax.random.randint(k2, shape, 0, 100) == 0
+    return jnp.where(esc, rand_int(k3, shape), u)
+
+
+def biased_rand(key, n, k, shape=()):
+    """Quadratic bias toward n-1: P(n-1) = k * P(0) (rand.go:104-109)."""
+    nf = jnp.asarray(n, jnp.float32)
+    kf = jnp.asarray(k, jnp.float32)
+    rf = nf * (kf / 2 + 1) * jax.random.uniform(key, shape)
+    bf = (-1 + jnp.sqrt(1 + 2 * kf * rf / nf)) * nf / kf
+    return jnp.clip(bf.astype(jnp.int32), 0, jnp.asarray(n, jnp.int32) - 1)
+
+
+def sample_flags(key, flags_off, flags_cnt, pool, shape=()):
+    """Flag-combination sampler (rand.go:140-154): usually OR of a geometric
+    number of set members, sometimes a single member, zero, or garbage.
+
+    flags_off/flags_cnt may be arrays broadcastable to `shape` (each lane can
+    sample from a different flag set out of the shared pool)."""
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    cnt = jnp.maximum(jnp.asarray(flags_cnt), 1)
+    off = jnp.asarray(flags_off)
+    # 4 candidate members; member j included with prob 2^-j (geometric OR)
+    idx = jax.random.randint(k2, shape + (4,), 0, 1 << 30) % cnt[..., None]
+    vals = pool[off[..., None] + idx]
+    include = jax.random.uniform(k3, shape + (4,)) < jnp.array(
+        [1.0, 0.5, 0.25, 0.125])
+    ored = jnp.where(include, vals, 0).reshape(shape + (4,))
+    ored = jnp.bitwise_or.reduce(ored, axis=-1)
+    single = vals[..., 0]
+    cat = jax.random.randint(k1, shape, 0, 111)
+    garbage = rand_u64(k4, shape)
+    return jnp.select(
+        [cat < 90, cat < 100, cat < 110],
+        [ored, single, jnp.zeros_like(garbage)],
+        garbage,
+    )
+
+
+def choose_weighted(key, cumsum_row):
+    """Sample an index from an int cumulative-weight row (prio.go:231-247:
+    uniform in [0, total) then binary search)."""
+    total = cumsum_row[-1]
+    x = jax.random.randint(key, (), 0, jnp.maximum(total, 1),
+                           dtype=cumsum_row.dtype)
+    return jnp.searchsorted(cumsum_row, x, side="right").astype(jnp.int32)
+
+
+def pick_masked(key, mask):
+    """Uniformly pick an index where mask is true (-1 if none)."""
+    u = jax.random.uniform(key, mask.shape)
+    score = jnp.where(mask, u, -1.0)
+    idx = jnp.argmax(score)
+    return jnp.where(jnp.any(mask), idx.astype(jnp.int32), -1)
