@@ -1,0 +1,83 @@
+"""Headline benchmark: batched device mutation throughput (programs/sec).
+
+Mirrors BASELINE.json config[0] (`tools/syz-mutate` in a loop = raw
+single-proc mutation throughput; reference tool at
+/root/reference/tools/syz-mutate/mutate.go).  The CPU baseline is measured
+in-process: the host-side tree mutator (syzkaller_tpu/prog/mutation.py, the
+reimplementation of prog/mutation.go semantics) run single-threaded on this
+machine — the Go reference cannot be built here (no Go toolchain in the
+image), so `vs_baseline` is device-vs-host-CPU on identical program
+distributions.
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def bench_device(dt, B=4096, C=16, iters=20, warmup=3):
+    import jax
+    from syzkaller_tpu.ops import mutation as dmut
+
+    key = jax.random.PRNGKey(0)
+    cid, sval, data = dmut.generate_batch(key, dt, B=B, C=C)
+    jax.block_until_ready(cid)
+
+    def step(k, c, s, d):
+        return dmut.mutate_batch(k, dt, c, s, d)
+
+    for i in range(warmup):
+        cid, sval, data = step(jax.random.fold_in(key, i), cid, sval, data)
+    jax.block_until_ready(cid)
+
+    t0 = time.perf_counter()
+    for i in range(iters):
+        cid, sval, data = step(jax.random.fold_in(key, 100 + i),
+                               cid, sval, data)
+    jax.block_until_ready(cid)
+    dt_s = time.perf_counter() - t0
+    return B * iters / dt_s
+
+
+def bench_host_cpu(target, n=300, ncalls=16):
+    """Single-proc host-CPU mutation baseline (syz-mutate-in-a-loop)."""
+    from syzkaller_tpu.prog.generation import RandGen, generate
+    from syzkaller_tpu.prog.mutation import mutate
+
+    rng = RandGen(target, seed=0)
+    progs = [generate(target, i, ncalls) for i in range(32)]
+    t0 = time.perf_counter()
+    for i in range(n):
+        p = progs[i % len(progs)].clone()
+        mutate(p, rng, ncalls, corpus=progs)
+    return n / (time.perf_counter() - t0)
+
+
+def main():
+    from syzkaller_tpu.descriptions.tables import get_tables
+    from syzkaller_tpu.ops.dtables import build_device_tables
+    from syzkaller_tpu.prog import get_target
+    from syzkaller_tpu.prog.tensor import TensorFormat
+
+    target = get_target("linux", "amd64")
+    tables = get_tables(target)
+    fmt = TensorFormat.for_tables(tables, max_calls=16)
+    dt = build_device_tables(tables, fmt)
+
+    dev = bench_device(dt, C=fmt.max_calls)
+    host = bench_host_cpu(target)
+
+    print(json.dumps({
+        "metric": "mutation_throughput",
+        "value": round(dev, 1),
+        "unit": "progs/sec",
+        "vs_baseline": round(dev / host, 2),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,23 +20,12 @@ import jax.numpy as jnp
 
 from ..descriptions.tables import (
     SK_DATA,
-    SK_LEN,
-    SK_PTR,
     SK_REF,
     SK_VALUE,
     SK_VMA,
     TK_BUF_BLOB,
     TK_BUF_FILE,
     TK_BUF_STR,
-    TK_BUF_TEXT,
-    TK_CONST,
-    TK_CSUM,
-    TK_FLAGS,
-    TK_INT,
-    TK_LEN,
-    TK_PROC,
-    TK_RES,
-    TK_VMA,
     CompiledTables,
 )
 from ..prog.tensor import REF_NONE, TensorFormat

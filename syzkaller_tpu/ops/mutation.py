@@ -122,7 +122,7 @@ def value_mutate(key, dt: DeviceTables, row: Row) -> Row:
 
 def data_mutate(key, dt: DeviceTables, row: Row) -> Row:
     cid, sval, data = row
-    kpick, kop, kpos, kval, klen = jax.random.split(key, 5)
+    kpick, kop, kpos, kbit, kval, klen = jax.random.split(key, 6)
     sc = _safe(cid)
     kind = dt.slot_kind[sc]
     mutable = _slot_index_mask(dt, cid) & (kind == SK_DATA)
@@ -147,7 +147,7 @@ def data_mutate(key, dt: DeviceTables, row: Row) -> Row:
     new_byte = jnp.select(
         [op == 0, op == 1, op == 2, op == 3],
         [rb,
-         cur_byte ^ (1 << jax.random.randint(kpos, (), 0, 8)),
+         cur_byte ^ (1 << jax.random.randint(kbit, (), 0, 8)),
          interesting,
          (cur_byte + delta) & 0xFF],
         cur_byte) & 0xFF
@@ -278,12 +278,14 @@ def splice(key, dt: DeviceTables, row: Row, donor: Row) -> Row:
     cid, sval, data = row
     dcid, dsval, ddata = donor
     C = cid.shape[0]
-    k = 1 + jax.random.randint(key, (), 0, C // 2)
+    # clamp the spliced prefix to the donor's live-call count so the result
+    # keeps the contiguous-live-prefix invariant REF decoding relies on
+    dlive = jnp.sum(_live(dcid))
+    k = jnp.minimum(1 + jax.random.randint(key, (), 0, C // 2), dlive)
     ar = jnp.arange(C)
-    take_donor = (ar < k) & (dcid >= 0)
+    take_donor = ar < k
     src_own = jnp.maximum(ar - k, 0)
     new_cid = jnp.where(take_donor, dcid, cid[src_own])
-    new_cid = jnp.where(~take_donor & (ar < k), -1, new_cid)
     new_sval = jnp.where(take_donor[:, None], dsval, sval[src_own])
     new_data = jnp.where(take_donor[:, None], ddata, data[src_own])
 
@@ -297,7 +299,10 @@ def splice(key, dt: DeviceTables, row: Row, donor: Row) -> Row:
     own_v = jnp.where(own_v >= U64(C), REF_NONE_U, own_v)
     fixed = jnp.where(take_donor[:, None], donor_v, own_v)
     new_sval = jnp.where(is_ref, fixed, new_sval)
-    return new_cid, new_sval, new_data
+    ok = dlive > 0
+    return (jnp.where(ok, new_cid, cid),
+            jnp.where(ok, new_sval, sval),
+            jnp.where(ok, new_data, data))
 
 
 # ---------------------------------------------------------------------- #
@@ -330,10 +335,11 @@ def mutate_program(key, dt: DeviceTables, row: Row, donor: Row,
     return row
 
 
-@partial(jax.jit, static_argnames=("rounds",))
-def mutate_batch(key, dt: DeviceTables, call_id, slot_val, data,
-                 rounds: int = 2):
-    """Vmapped batch mutation; donors are the batch rolled by one."""
+def mutate_rows(key, dt: DeviceTables, call_id, slot_val, data,
+                rounds: int = 2):
+    """Unjitted vmapped batch mutation; donors are the batch rolled by
+    one.  Shared by the single-chip `mutate_batch` and the sharded
+    per-device body in parallel/mesh.py."""
     B = call_id.shape[0]
     keys = jax.random.split(key, B)
     donor = (jnp.roll(call_id, 1, axis=0),
@@ -345,6 +351,12 @@ def mutate_batch(key, dt: DeviceTables, call_id, slot_val, data,
                               (dcid, dsval, ddat), rounds)
 
     return jax.vmap(per)(keys, call_id, slot_val, data, *donor)
+
+
+@partial(jax.jit, static_argnames=("rounds",))
+def mutate_batch(key, dt: DeviceTables, call_id, slot_val, data,
+                 rounds: int = 2):
+    return mutate_rows(key, dt, call_id, slot_val, data, rounds)
 
 
 def _sample_values(key, dt: DeviceTables, ids):
@@ -417,9 +429,14 @@ def generate_program(key, dt: DeviceTables, C: int, ncalls) -> Row:
     return cid, sval, data
 
 
-@partial(jax.jit, static_argnames=("B", "C"))
-def generate_batch(key, dt: DeviceTables, *, B: int, C: int):
+def generate_rows(key, dt: DeviceTables, *, B: int, C: int):
+    """Unjitted batched generation body (shared with parallel/mesh.py)."""
     kn, kg = jax.random.split(key)
     ncalls = 1 + jax.random.randint(kn, (B,), 0, C)
     keys = jax.random.split(kg, B)
     return jax.vmap(lambda k, n: generate_program(k, dt, C, n))(keys, ncalls)
+
+
+@partial(jax.jit, static_argnames=("B", "C"))
+def generate_batch(key, dt: DeviceTables, *, B: int, C: int):
+    return generate_rows(key, dt, B=B, C=C)

@@ -60,8 +60,9 @@ def rand_range_int(key, lo, hi, shape=()):
     k1, k2, k3 = jax.random.split(key, 3)
     lo = jnp.asarray(lo, jnp.uint64)
     hi = jnp.asarray(hi, jnp.uint64)
-    span = jnp.maximum(hi - lo + 1, 1)
-    u = rand_u64(k1, shape) % span + lo
+    raw = rand_u64(k1, shape)
+    span = hi - lo + 1  # wraps to 0 for the full u64 range
+    u = jnp.where(span == 0, raw, raw % jnp.maximum(span, 1) + lo)
     esc = jax.random.randint(k2, shape, 0, 100) == 0
     return jnp.where(esc, rand_int(k3, shape), u)
 

@@ -1,0 +1,3 @@
+from ..utils.jaxcfg import ensure_x64
+
+ensure_x64()

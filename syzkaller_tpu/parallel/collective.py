@@ -1,0 +1,47 @@
+"""Collective primitives for the fuzzing mesh.
+
+The reference's "distributed backend" is net/rpc-over-TCP between managers
+and fuzzers plus syz-hub delta sync (reference:
+/root/reference/pkg/rpctype/rpc.go:20-90, syz-hub/hub.go:85-117).  The
+TPU-native equivalent keeps RPC only at the host boundary; *signal-state*
+merging between chips rides ICI as XLA collectives:
+
+  - coverage/signal union  = bitwise-OR all-reduce over packed bitsets,
+  - "any chip saw new signal" = boolean psum,
+  - corpus/candidate exchange = all_gather of program tensors
+    (the hub-sync analogue; across pods the same op rides DCN).
+"""
+
+from __future__ import annotations
+
+from . import ensure_x64  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+
+
+def or_all_reduce(x, axis_name: str):
+    """Bitwise-OR all-reduce along a mesh axis.
+
+    XLA has no named OR collective for packed integer lanes, so this is an
+    all_gather + local OR-reduce; on TPU the gather rides ICI and the
+    reduce fuses into the consumer.  Used for cross-chip signal-bitset
+    union (the pkg/cover SignalAdd merge, distributed)."""
+    g = jax.lax.all_gather(x, axis_name)  # [n, ...]
+    return jax.lax.reduce(g, jnp.zeros((), x.dtype),
+                          jax.lax.bitwise_or, (0,))
+
+
+def any_all_reduce(x, axis_name: str):
+    """Boolean OR all-reduce (elementwise) along a mesh axis."""
+    return jax.lax.psum(x.astype(jnp.int32), axis_name) > 0
+
+
+def gather_programs(row, axis_name: str):
+    """All-gather program-tensor shards along a mesh axis and flatten the
+    device dimension into the batch dimension (candidate exchange; the
+    syz-hub corpus sync analogue)."""
+    def g(x):
+        y = jax.lax.all_gather(x, axis_name)
+        return y.reshape((-1,) + y.shape[2:])
+    return jax.tree_util.tree_map(g, row)

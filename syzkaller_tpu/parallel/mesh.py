@@ -1,0 +1,187 @@
+"""The fuzzing mesh: how one device-step is sharded across chips.
+
+Parallelism axes (the TPU translation of the reference's axes, SURVEY §2.6):
+
+  - ``fuzz``  — data parallelism over candidate programs.  Each chip
+    mutates/generates its own shard of the candidate batch (the analogue
+    of the reference's `procs` × VM-fleet parallelism,
+    /root/reference/syz-fuzzer/fuzzer.go:248-328).
+  - ``cover`` — the global signal bitset is *sharded by word range* across
+    this axis (the analogue of sharding the manager's maxSignal map).
+    Folding executed signals into the set and testing candidates for new
+    signal are collectives: signals all_gather over ``fuzz`` to reach the
+    owning shard, per-shard hit masks psum over ``cover``.
+
+Within a slice these collectives ride ICI; the same program laid over a
+multi-pod mesh rides DCN for the leading axis — no code change, only the
+Mesh construction differs (hub-sync analogue).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+from . import ensure_x64  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.dtables import DeviceTables
+from ..ops import mutation as dmut
+from .collective import or_all_reduce
+
+AXIS_FUZZ = "fuzz"
+AXIS_COVER = "cover"
+
+U32 = jnp.uint32
+SENT = jnp.uint32(0xFFFFFFFF)
+
+
+def make_mesh(n_devices: Optional[int] = None, n_cover: Optional[int] = None,
+              devices=None) -> Mesh:
+    """Build the 2-D (fuzz, cover) mesh over the available devices."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    if n_cover is None:
+        n_cover = 2 if n % 2 == 0 and n > 1 else 1
+    assert n % n_cover == 0, (n, n_cover)
+    arr = np.asarray(devices).reshape(n // n_cover, n_cover)
+    return Mesh(arr, (AXIS_FUZZ, AXIS_COVER))
+
+
+# ---------------------------------------------------------------------- #
+# program fingerprints (proxy signal)
+
+
+def call_fingerprints(cid, sval) -> jnp.ndarray:
+    """Per-call u32 fingerprint of a program [C] — a splitmix-style hash of
+    the call id, its slot values, and the running prefix hash (the same
+    shape as the executor's edge signal ``pc ^ hash(prev)``,
+    /root/reference/executor/executor.h:388-401).  Used for candidate
+    dedup before execution and as the proxy signal in hermetic/dry runs;
+    real coverage signal comes from the executor."""
+    U64 = jnp.uint64
+
+    def mix(h):
+        h = (h ^ (h >> 30)) * U64(0xBF58476D1CE4E5B9)
+        h = (h ^ (h >> 27)) * U64(0x94D049BB133111EB)
+        return h ^ (h >> 31)
+
+    hv = mix(jnp.asarray(sval, U64).sum(axis=-1) ^
+             (jnp.asarray(cid, U64) + U64(0x9E3779B97F4A7C15)))
+
+    def step(prev, h):
+        out = mix(h ^ prev)
+        return out, out
+    _, sig = jax.lax.scan(step, U64(0), hv)
+    live = jnp.asarray(cid) >= 0
+    return jnp.where(live, (sig & U64(0xFFFFFFFF)).astype(U32), SENT)
+
+
+# ---------------------------------------------------------------------- #
+# sharded signal bitset ops (word-range sharded over AXIS_COVER)
+
+
+def _shard_hits(sig_shard, sigs, shard_idx):
+    """bitset_test against this device's word range; sigs outside the
+    range report False here and are answered by the owning shard."""
+    w = sig_shard.shape[0]
+    h = jnp.asarray(sigs, U32)
+    word = (h >> 5) % jnp.uint32(w * jax.lax.psum(1, AXIS_COVER))
+    lo = jnp.uint32(shard_idx * w)
+    mine = (word >= lo) & (word < lo + jnp.uint32(w)) & (h != SENT)
+    lw = jnp.where(mine, word - lo, 0)
+    hit = (sig_shard[lw] >> (h & U32(31))) & U32(1)
+    return mine, (hit == 1) & mine
+
+
+def fold_signals(sig_shard, sigs):
+    """Inside shard_map: union executed signals (sharded over ``fuzz``,
+    [b, K] u32 padded SENT) into the word-sharded global bitset; return
+    (new sig_shard, fresh[b] bool = program produced signal not seen
+    before anywhere).  Distributed SignalNew + SignalAdd
+    (/root/reference/pkg/cover/cover.go:160-182)."""
+    j = jax.lax.axis_index(AXIS_COVER)
+    # --- test: per-shard hits, then combine over the cover axis ---
+    mine, hit = _shard_hits(sig_shard, sigs, j)
+    fresh_local = jnp.any(mine & ~hit, axis=-1)
+    fresh = jax.lax.psum(fresh_local.astype(jnp.int32), AXIS_COVER) > 0
+    # --- fold: gather every fuzz-shard's signals, scatter my range ---
+    allsigs = jax.lax.all_gather(sigs, AXIS_FUZZ).reshape(-1)
+    w = sig_shard.shape[0]
+    h = jnp.asarray(allsigs, U32)
+    word = (h >> 5) % jnp.uint32(w * jax.lax.psum(1, AXIS_COVER))
+    lo = jnp.uint32(j * w)
+    mine_all = (word >= lo) & (word < lo + jnp.uint32(w)) & (h != SENT)
+    lw = jnp.where(mine_all, word - lo, 0)
+    mask = jnp.where(mine_all, U32(1) << (h & U32(31)), U32(0))
+    sig_shard = jnp.bitwise_or.at(sig_shard, lw, mask, inplace=False)
+    return sig_shard, fresh
+
+
+# ---------------------------------------------------------------------- #
+# the sharded fuzz step
+
+
+def _step_body(dt: DeviceTables, rounds: int, key, cid, sval, data,
+               sig_shard):
+    """Per-device body under shard_map: mutate my candidate shard, proxy-
+    fingerprint it, fold+test against the sharded global signal set."""
+    i = jax.lax.axis_index(AXIS_FUZZ)
+    j = jax.lax.axis_index(AXIS_COVER)
+    key = jax.random.fold_in(jax.random.fold_in(key, i), j)
+    cid, sval, data = dmut.mutate_rows(key, dt, cid, sval, data, rounds)
+    sigs = jax.vmap(call_fingerprints)(cid, sval)      # [b, C] u32
+    sig_shard, fresh = fold_signals(sig_shard, sigs)
+    return cid, sval, data, sig_shard, fresh
+
+
+def make_fuzz_step(mesh: Mesh, dt: DeviceTables, *, rounds: int = 2):
+    """Compile the full sharded fuzz step over `mesh`.
+
+    Returns (step, sharding) where
+      step(key, cid, sval, data, sig_shard)
+        -> (cid, sval, data, sig_shard, fresh)
+    cid/sval/data are batch-sharded over ``fuzz`` (batch must divide the
+    fuzz axis), sig_shard is the full bitset sharded over ``cover`` (word
+    count must divide the cover axis), key is replicated."""
+    pspec_batch = P(AXIS_FUZZ)
+    pspec_sig = P(AXIS_COVER)
+
+    body = partial(_step_body, dt, rounds)
+    mapped = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), pspec_batch, pspec_batch, pspec_batch, pspec_sig),
+        out_specs=(pspec_batch, pspec_batch, pspec_batch, pspec_sig,
+                   pspec_batch),
+        check_vma=False)
+    step = jax.jit(mapped)
+    shardings = {
+        "batch": NamedSharding(mesh, pspec_batch),
+        "signal": NamedSharding(mesh, pspec_sig),
+        "replicated": NamedSharding(mesh, P()),
+    }
+    return step, shardings
+
+
+def make_generate_step(mesh: Mesh, dt: DeviceTables, *, C: int):
+    """Sharded batched generation: each fuzz-shard generates its own lanes
+    (seed corpus bootstrap, reference fuzzer.go:315)."""
+
+    def body(key, dummy):
+        i = jax.lax.axis_index(AXIS_FUZZ)
+        j = jax.lax.axis_index(AXIS_COVER)
+        key = jax.random.fold_in(jax.random.fold_in(key, i), j)
+        return dmut.generate_rows(key, dt, B=dummy.shape[0], C=C)
+
+    mapped = jax.shard_map(
+        body, mesh=mesh, in_specs=(P(), P(AXIS_FUZZ)),
+        out_specs=(P(AXIS_FUZZ), P(AXIS_FUZZ), P(AXIS_FUZZ)),
+        check_vma=False)
+    return jax.jit(mapped)

@@ -125,3 +125,48 @@ def test_refs_stay_in_bounds_after_mutation(env):
                     continue
                 assert v < c, f"ref at ({b},{c},{s}) -> {v} not earlier"
                 assert cid_np[b, v] >= 0, "ref to dead call"
+
+
+def test_splice_keeps_live_prefix_contiguous(env):
+    """Regression: splice with a donor whose live-call count is smaller
+    than the splice point must not leave dead-call holes mid-program
+    (REF values are row indices; decode assumes a contiguous live
+    prefix)."""
+    import jax.numpy as jnp
+    from syzkaller_tpu.ops.mutation import splice
+
+    target, tables, fmt, dt = env
+    C, S, D = fmt.max_calls, dt.max_slots, dt.arena
+    own_cid = jnp.array([65] + [-1] * (C - 1), jnp.int32)
+    donor_cid = jnp.array([134] + [-1] * (C - 1), jnp.int32)
+    zeros_s = jnp.zeros((C, S), jnp.uint64)
+    zeros_d = jnp.zeros((C, D), jnp.uint8)
+    for seed in range(32):
+        cid, _, _ = splice(jax.random.PRNGKey(seed), dt,
+                           (own_cid, zeros_s, zeros_d),
+                           (donor_cid, zeros_s, zeros_d))
+        cid = np.asarray(cid)
+        nlive = int((cid >= 0).sum())
+        assert (cid[:nlive] >= 0).all() and (cid[nlive:] == -1).all(), cid
+
+    # empty donor: splice is a no-op
+    empty = jnp.full((C,), -1, jnp.int32)
+    cid, _, _ = splice(jax.random.PRNGKey(0), dt,
+                       (own_cid, zeros_s, zeros_d),
+                       (empty, zeros_s, zeros_d))
+    np.testing.assert_array_equal(np.asarray(cid), np.asarray(own_cid))
+
+
+def test_mutate_batch_live_prefix_invariant(env):
+    """All mutation ops combined must preserve the contiguous-live-prefix
+    invariant across many rounds."""
+    target, tables, fmt, dt = env
+    cid, sval, data = M.generate_batch(
+        jax.random.PRNGKey(3), dt, B=B, C=fmt.max_calls)
+    for r in range(4):
+        cid, sval, data = M.mutate_batch(
+            jax.random.PRNGKey(100 + r), dt, cid, sval, data)
+    carr = np.asarray(cid)
+    for rowv in carr:
+        nlive = int((rowv >= 0).sum())
+        assert (rowv[:nlive] >= 0).all() and (rowv[nlive:] == -1).all(), rowv

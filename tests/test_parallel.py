@@ -1,0 +1,94 @@
+"""Sharded fuzz-step tests on the virtual 8-device CPU mesh (conftest sets
+xla_force_host_platform_device_count=8)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+if len(jax.devices()) < 8:
+    pytest.skip("needs the virtual 8-device mesh", allow_module_level=True)
+
+import jax.numpy as jnp  # noqa: E402
+
+from syzkaller_tpu.descriptions.tables import get_tables  # noqa: E402
+from syzkaller_tpu.ops.dtables import build_device_tables  # noqa: E402
+from syzkaller_tpu.parallel import collective, mesh as pmesh  # noqa: E402
+from syzkaller_tpu.prog import get_target  # noqa: E402
+from syzkaller_tpu.prog.tensor import (  # noqa: E402
+    ProgBatch,
+    TensorFormat,
+    decode_batch,
+)
+
+NBITS = 1 << 14
+
+
+@pytest.fixture(scope="module")
+def env():
+    target = get_target("linux", "amd64")
+    tables = get_tables(target)
+    fmt = TensorFormat.for_tables(tables, max_calls=8)
+    dt = build_device_tables(tables, fmt)
+    m = pmesh.make_mesh()  # 4x2 over the 8 virtual devices
+    return target, tables, fmt, dt, m
+
+
+def test_mesh_shape(env):
+    *_, m = env
+    assert m.devices.size == 8
+    assert m.axis_names == (pmesh.AXIS_FUZZ, pmesh.AXIS_COVER)
+
+
+def test_or_all_reduce():
+    m = pmesh.make_mesh()
+    n = m.devices.shape[0]
+    x = jnp.arange(n * 4, dtype=jnp.uint32).reshape(n, 4)
+
+    out = jax.jit(jax.shard_map(
+        lambda v: collective.or_all_reduce(v, pmesh.AXIS_FUZZ),
+        mesh=m,
+        in_specs=jax.sharding.PartitionSpec(pmesh.AXIS_FUZZ),
+        out_specs=jax.sharding.PartitionSpec(pmesh.AXIS_FUZZ),
+        check_vma=False))(x)
+    expect = np.bitwise_or.reduce(np.asarray(x).reshape(n, 1, 4), axis=0)
+    np.testing.assert_array_equal(np.asarray(out)[:1], expect)
+
+
+def test_sharded_fuzz_step(env):
+    target, tables, fmt, dt, m = env
+    B, C = 16, fmt.max_calls
+    gen = pmesh.make_generate_step(m, dt, C=C)
+    key = jax.random.PRNGKey(7)
+    cid, sval, data = gen(key, jnp.zeros((B,), jnp.int32))
+
+    step, _ = pmesh.make_fuzz_step(m, dt)
+    sig = jnp.zeros(NBITS // 32, jnp.uint32)
+    cid2, sval2, data2, sig2, fresh = step(key, cid, sval, data, sig)
+
+    # shapes preserved, signal set grew, first step sees fresh signal
+    assert cid2.shape == (B, C)
+    assert sig2.shape == sig.shape
+    assert int(jnp.sum(jax.lax.population_count(sig2))) > 0
+    assert bool(jnp.any(fresh))
+
+    # every mutated lane still decodes to a valid executable program
+    batch = ProgBatch(np.asarray(cid2), np.asarray(sval2), np.asarray(data2))
+    for p in decode_batch(tables, fmt, batch):
+        p.validate()
+
+    # running the same batch again: no fresh signal (set is saturated
+    # w.r.t. these fingerprints) unless mutation changed programs -- so
+    # instead re-fold the *same* signals via a second identical step with
+    # mutation disabled is not exposed; check determinism of fold instead:
+    _, _, _, sig3, fresh3 = step(key, cid, sval, data, sig2)
+    np.testing.assert_array_equal(np.asarray(sig3), np.asarray(sig2) |
+                                  np.asarray(sig3))
+
+
+def test_fingerprints_mask_dead_calls(env):
+    target, tables, fmt, dt, m = env
+    cid = jnp.array([1, 2, -1, -1], jnp.int32)
+    sval = jnp.zeros((4, dt.max_slots), jnp.uint64)
+    sig = pmesh.call_fingerprints(cid, sval)
+    assert int(sig[2]) == 0xFFFFFFFF and int(sig[3]) == 0xFFFFFFFF
+    assert int(sig[0]) != 0xFFFFFFFF
