@@ -1,0 +1,893 @@
+// syz-executor (TPU build) — in-VM program interpreter.
+//
+// Role parity with reference /root/reference/executor/executor.h:151-299 and
+// executor_linux.cc:46-306, redesigned rather than translated:
+//
+//  * The syscall table is NOT compiled in (the reference generates 10.8k lines
+//    of per-OS headers, executor/syscalls_linux.h). Instead the fuzzer streams
+//    the call-id -> syscall-NR table through shared memory at handshake time,
+//    so one binary serves any description revision. This matters for the TPU
+//    build: the Python description compiler is the single source of truth and
+//    the device tables and executor table can never skew.
+//  * Control protocol: fixed 48-byte little-endian u64 request frames on
+//    stdin, 24-byte replies on stdout (the reference uses magic status bytes
+//    67/68/69, pkg/ipc/ipc_linux.go:309-...). Program input and result output
+//    travel through two mmap'd files exactly like the reference (2MB in /
+//    16MB out, pkg/ipc/ipc.go:36).
+//  * Coverage: per-thread KCOV (KCOV_ENABLE/KCOV_DISABLE ioctls, reference
+//    executor_linux.cc:262-306) with edge signal sig = pc ^ hash(prev) and an
+//    open-addressing dedup table (reference executor.h:388-401,497-527).
+//    Where KCOV is unavailable (containers, non-Linux dev hosts) a
+//    deterministic synthetic signal derived from (nr, errno) keeps the whole
+//    fuzzing loop runnable hermetically — the reference has no such fallback
+//    (SURVEY.md §4 flags that gap).
+//  * Threaded + collide execution: each call runs on a worker thread with a
+//    bounded completion wait; collide mode re-issues adjacent call pairs
+//    concurrently without waiting to provoke kernel races (reference
+//    executor.h:259-298).
+//  * Fork server: one child per program, private cwd, process-group kill on
+//    timeout (reference executor_linux.cc:144-...).
+//
+// Exec input format: see syzkaller_tpu/prog/encodingexec.py (byte-compatible
+// with reference prog/encodingexec.go:14-288).
+
+#include <errno.h>
+#include <fcntl.h>
+#include <pthread.h>
+#include <sched.h>
+#include <setjmp.h>
+#include <signal.h>
+#include <stdarg.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/ioctl.h>
+#include <sys/mman.h>
+#include <sys/prctl.h>
+#include <sys/resource.h>
+#include <sys/stat.h>
+#include <sys/syscall.h>
+#include <sys/time.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <time.h>
+#include <unistd.h>
+
+typedef uint64_t uint64;
+typedef uint32_t uint32;
+
+// ---------------- protocol constants (mirrored in ipc/protocol.py) ---------
+
+const uint64 kReqMagic = 0x73797A74707500AAull;
+const uint64 kReplyMagic = 0x73797A74707500BBull;
+
+const uint64 kCmdHandshake = 1;
+const uint64 kCmdExec = 2;
+const uint64 kCmdQuit = 3;
+
+// env flags (handshake req.flags)
+const uint64 kEnvDebug = 1 << 0;
+const uint64 kEnvUseKcov = 1 << 1;
+const uint64 kEnvSandboxSetuid = 1 << 2;
+const uint64 kEnvSandboxNamespace = 1 << 3;
+const uint64 kEnvSyntheticCover = 1 << 4;
+const uint64 kEnvPremapArena = 1 << 5;
+
+// exec flags (exec req.exec_flags low 32 bits; fault call/nth in high bits)
+const uint64 kExecCollectSignal = 1 << 0;
+const uint64 kExecCollectCover = 1 << 1;
+const uint64 kExecDedupCover = 1 << 2;
+const uint64 kExecThreaded = 1 << 3;
+const uint64 kExecCollide = 1 << 4;
+const uint64 kExecCollectComps = 1 << 5;
+const uint64 kExecInjectFault = 1 << 6;
+
+const uint64 kStatusOk = 0;
+const uint64 kStatusFailed = 1;
+const uint64 kStatusHanged = 2;
+
+// exec stream markers (prog/encodingexec.py)
+const uint64 kInstrEof = ~0ull;
+const uint64 kInstrCopyin = ~0ull - 1;
+const uint64 kInstrCopyout = ~0ull - 2;
+const uint64 kArgConst = 0;
+const uint64 kArgResult = 1;
+const uint64 kArgData = 2;
+const uint64 kArgCsum = 3;
+
+const uint64 kPseudoNrBase = 1ull << 30;  // descriptions/compiler.py:58
+
+// call record flags
+const uint32 kCallExecuted = 1 << 0;
+const uint32 kCallFaultInjected = 1 << 1;
+
+const int kMaxThreads = 16;
+const int kMaxInstr = 16 << 10;
+const int kMaxArgs = 6;
+const int kCallWaitMs = 20;       // reference executor.h:268
+const int kFinalWaitMs = 100;
+const int kCoverSize = 64 << 10;
+const int kDedupTableSize = 8 << 10;
+
+// kcov ioctls (reference executor_linux.cc:27-40)
+#define KCOV_INIT_TRACE _IOR('c', 1, unsigned long)
+#define KCOV_ENABLE _IO('c', 100)
+#define KCOV_DISABLE _IO('c', 101)
+#define KCOV_TRACE_PC 0
+#define KCOV_TRACE_CMP 1
+
+struct req_t {
+  uint64 magic, cmd, flags, pid, exec_flags, timeout_ms;
+};
+struct reply_t {
+  uint64 magic, status, exec_ns;
+};
+
+// ---------------- globals -------------------------------------------------
+
+static bool flag_debug;
+static bool flag_kcov;
+static bool flag_synthetic;
+static bool flag_premap;
+static uint64 flag_sandbox;
+
+static char* in_mem;
+static char* out_mem;
+static size_t in_size, out_size;
+
+static uint64 g_ncalls_table;      // syscall table from handshake
+static uint64* g_nr_table;
+static uint64 g_page_size = 4096;
+static uint64 g_num_pages = 4096;
+static uint64 g_data_offset = 0x10000000;
+
+static int g_pid;
+static bool collect_signal, collect_cover, dedup_cover, collect_comps;
+static bool flag_threaded, flag_collide;
+static int fault_call = -1, fault_nth;
+
+static __thread sigjmp_buf nonfail_jmp;
+static __thread int nonfail_active;
+
+static void debug(const char* msg, ...) {
+  if (!flag_debug) return;
+  va_list args;
+  va_start(args, msg);
+  vfprintf(stderr, msg, args);
+  va_end(args);
+  fflush(stderr);
+}
+
+[[noreturn]] static void fail(const char* msg) {
+  fprintf(stderr, "executor: %s (errno %d: %s)\n", msg, errno,
+          strerror(errno));
+  _exit(67);
+}
+
+static uint64 now_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64)ts.tv_sec * 1000000000ull + ts.tv_nsec;
+}
+
+// ---------------- NONFAILING memory access --------------------------------
+// Tolerates copyin/copyout on unmapped addresses the same way the reference
+// runtime does with setjmp+SIGSEGV (reference executor/common_linux.h
+// NONFAILING); mutation can aim pointers anywhere.
+
+static void segv_handler(int sig, siginfo_t*, void*) {
+  if (nonfail_active) siglongjmp(nonfail_jmp, 1);
+  _exit(128 + sig);
+}
+
+static void install_segv_handler() {
+  struct sigaction sa;
+  memset(&sa, 0, sizeof(sa));
+  sa.sa_sigaction = segv_handler;
+  sa.sa_flags = SA_SIGINFO | SA_NODEFER;
+  sigaction(SIGSEGV, &sa, nullptr);
+  sigaction(SIGBUS, &sa, nullptr);
+}
+
+#define NONFAILING(...)                      \
+  do {                                       \
+    nonfail_active = 1;                      \
+    if (!sigsetjmp(nonfail_jmp, 1)) {        \
+      __VA_ARGS__;                           \
+    }                                        \
+    nonfail_active = 0;                      \
+  } while (0)
+
+// ---------------- coverage ------------------------------------------------
+
+static inline uint32 hash32(uint32 x) {
+  x ^= x >> 16;
+  x *= 0x85ebca6b;
+  x ^= x >> 13;
+  x *= 0xc2b2ae35;
+  x ^= x >> 16;
+  return x;
+}
+
+struct cover_t {
+  int fd = -1;
+  uint64* data = nullptr;   // data[0] = count, then pcs
+  bool usable = false;
+};
+
+static bool kcov_open(cover_t* cov) {
+  cov->fd = open("/sys/kernel/debug/kcov", O_RDWR);
+  if (cov->fd == -1) return false;
+  if (ioctl(cov->fd, KCOV_INIT_TRACE, kCoverSize)) {
+    close(cov->fd);
+    cov->fd = -1;
+    return false;
+  }
+  cov->data = (uint64*)mmap(nullptr, kCoverSize * sizeof(uint64),
+                            PROT_READ | PROT_WRITE, MAP_SHARED, cov->fd, 0);
+  if (cov->data == MAP_FAILED) {
+    close(cov->fd);
+    cov->fd = -1;
+    cov->data = nullptr;
+    return false;
+  }
+  cov->usable = true;
+  return true;
+}
+
+static void kcov_enable(cover_t* cov, bool comps) {
+  if (!cov->usable) return;
+  ioctl(cov->fd, KCOV_ENABLE, comps ? KCOV_TRACE_CMP : KCOV_TRACE_PC);
+  __atomic_store_n(&cov->data[0], 0, __ATOMIC_RELAXED);
+}
+
+static void kcov_reset(cover_t* cov) {
+  if (cov->usable) __atomic_store_n(&cov->data[0], 0, __ATOMIC_RELAXED);
+}
+
+// ---------------- output region -------------------------------------------
+// Layout (u32 LE): [0]=completed call count; then per call:
+//   index num errno flags nsig ncover ncomps  sig[nsig] cover[ncover]
+//   comps[2*ncomps as u64 pairs -> 4*ncomps u32]
+// The count at [0] is bumped only after the record is fully written, so a
+// killed child leaves a consistent prefix (reference executor.h:336-428).
+
+static uint32* out_pos;
+
+static void out_reset() {
+  ((uint32*)out_mem)[0] = 0;
+  out_pos = (uint32*)out_mem + 1;
+}
+
+static inline bool out_fits(size_t nwords) {
+  return (char*)(out_pos + nwords) <= out_mem + out_size;
+}
+
+// ---------------- threads -------------------------------------------------
+
+struct thread_t {
+  int id = 0;
+  bool created = false;
+  pthread_t th;
+  pthread_mutex_t mu = PTHREAD_MUTEX_INITIALIZER;
+  pthread_cond_t cv = PTHREAD_COND_INITIALIZER;
+  int state = 0;  // 0 idle, 1 pending, 2 running, 3 done
+  bool quit = false;
+
+  // call payload
+  int call_index = 0;     // position in program
+  int call_num = 0;       // dense call id
+  uint64 nr = 0;
+  uint64 args[kMaxArgs] = {};
+  int copyout_index = -1;  // instruction index of the call itself
+  bool do_fault = false;
+  int fault_nth_local = 0;
+
+  // result
+  uint64 ret = 0;
+  int err = 0;
+  bool executed = false;
+  bool fault_injected = false;
+  bool collect = true;     // write an output record for this execution
+
+  cover_t cov;
+};
+
+static thread_t threads[kMaxThreads];
+
+struct result_t {
+  bool valid = false;
+  uint64 val = 0;
+};
+static result_t results[kMaxInstr];
+
+static bool fault_injection_enter(thread_t* th) {
+  if (!th->do_fault) return false;
+  int fd = open("/proc/thread-self/fail-nth", O_RDWR);
+  if (fd == -1) return false;
+  char buf[16];
+  int n = snprintf(buf, sizeof(buf), "%d", th->fault_nth_local + 1);
+  ssize_t w = write(fd, buf, n);
+  (void)w;
+  close(fd);
+  return true;
+}
+
+static bool fault_injection_check(thread_t* th) {
+  if (!th->do_fault) return false;
+  int fd = open("/proc/thread-self/fail-nth", O_RDONLY);
+  if (fd == -1) return false;
+  char buf[16] = {};
+  ssize_t r = read(fd, buf, sizeof(buf) - 1);
+  close(fd);
+  return r > 0 && atoi(buf) == 0;
+}
+
+static uint64 execute_pseudo(uint64 nr, uint64* args, int* err) {
+  // syz_* pseudo-syscalls. The descriptions compiler assigns ids
+  // kPseudoNrBase+idx in order of first appearance; the current description
+  // set defines none, so any id is ENOSYS until implementations land here.
+  (void)nr;
+  (void)args;
+  *err = ENOSYS;
+  return (uint64)-1;
+}
+
+static void execute_call(thread_t* th) {
+  if (flag_kcov) kcov_reset(&th->cov);
+  bool faulted = fault_injection_enter(th);
+  errno = 0;
+  uint64 ret;
+  int err = 0;
+  if (th->nr >= kPseudoNrBase) {
+    ret = execute_pseudo(th->nr, th->args, &err);
+  } else {
+    ret = (uint64)syscall(th->nr, th->args[0], th->args[1], th->args[2],
+                          th->args[3], th->args[4], th->args[5]);
+    err = (ret == (uint64)-1) ? errno : 0;
+  }
+  th->ret = ret;
+  th->err = err;
+  th->executed = true;
+  th->fault_injected = faulted && fault_injection_check(th);
+}
+
+static void* worker(void* arg) {
+  thread_t* th = (thread_t*)arg;
+  install_segv_handler();  // handlers are per-process but jmpbuf is per-thread
+  if (flag_kcov) {
+    kcov_open(&th->cov);
+    kcov_enable(&th->cov, collect_comps);
+  }
+  pthread_mutex_lock(&th->mu);
+  for (;;) {
+    while (th->state != 1 && !th->quit)
+      pthread_cond_wait(&th->cv, &th->mu);
+    if (th->quit) break;
+    th->state = 2;
+    pthread_mutex_unlock(&th->mu);
+    execute_call(th);
+    pthread_mutex_lock(&th->mu);
+    th->state = 3;
+    pthread_cond_broadcast(&th->cv);
+  }
+  pthread_mutex_unlock(&th->mu);
+  return nullptr;
+}
+
+static void thread_start(thread_t* th) {
+  if (th->created) return;
+  th->created = true;
+  pthread_attr_t attr;
+  pthread_attr_init(&attr);
+  pthread_attr_setstacksize(&attr, 128 << 10);
+  if (pthread_create(&th->th, &attr, worker, th)) fail("pthread_create");
+  pthread_attr_destroy(&attr);
+}
+
+static void schedule_call(thread_t* th) {
+  pthread_mutex_lock(&th->mu);
+  th->state = 1;
+  pthread_cond_signal(&th->cv);
+  pthread_mutex_unlock(&th->mu);
+}
+
+// Returns true if the call completed within timeout_ms.
+static bool wait_call(thread_t* th, int timeout_ms) {
+  struct timespec ts;
+  clock_gettime(CLOCK_REALTIME, &ts);
+  ts.tv_nsec += (long)timeout_ms * 1000000;
+  ts.tv_sec += ts.tv_nsec / 1000000000;
+  ts.tv_nsec %= 1000000000;
+  pthread_mutex_lock(&th->mu);
+  while (th->state != 3) {
+    if (pthread_cond_timedwait(&th->cv, &th->mu, &ts)) break;
+  }
+  bool done = th->state == 3;
+  pthread_mutex_unlock(&th->mu);
+  return done;
+}
+
+// ---------------- signal extraction ---------------------------------------
+
+static uint32 dedup_table[kDedupTableSize];
+
+static bool dedup(uint32 sig) {
+  for (int i = 0; i < 4; i++) {
+    uint32 pos = (sig + i) % kDedupTableSize;
+    if (dedup_table[pos] == sig) return true;
+    if (dedup_table[pos] == 0) {
+      dedup_table[pos] = sig;
+      return false;
+    }
+  }
+  return false;
+}
+
+// Writes one output record for a completed call (reference handle_completion,
+// executor.h:336-428).
+static void write_completion(thread_t* th) {
+  if (!th->collect) return;
+  if (!out_fits(7)) return;
+  uint32* rec = out_pos;
+  rec[0] = (uint32)th->call_index;
+  rec[1] = (uint32)th->call_num;
+  rec[2] = (uint32)th->err;
+  rec[3] = (th->executed ? kCallExecuted : 0) |
+           (th->fault_injected ? kCallFaultInjected : 0);
+  uint32 *nsig = &rec[4], *ncover = &rec[5], *ncomps = &rec[6];
+  *nsig = *ncover = *ncomps = 0;
+  out_pos = rec + 7;
+
+  if (flag_kcov && th->cov.usable && !collect_comps) {
+    uint64 n = __atomic_load_n(&th->cov.data[0], __ATOMIC_RELAXED);
+    if (n > kCoverSize - 1) n = kCoverSize - 1;
+    if (collect_signal) {
+      memset(dedup_table, 0, sizeof(dedup_table));
+      uint32 prev = 0;
+      for (uint64 i = 0; i < n && out_fits(1); i++) {
+        uint32 pc = (uint32)th->cov.data[i + 1];
+        uint32 sig = pc ^ (hash32(prev) & 0xfffff);
+        prev = pc;
+        if (dedup(sig)) continue;
+        *out_pos++ = sig;
+        (*nsig)++;
+      }
+    }
+    if (collect_cover) {
+      uint32 last = 0;
+      for (uint64 i = 0; i < n && out_fits(1); i++) {
+        uint32 pc = (uint32)th->cov.data[i + 1];
+        if (dedup_cover && pc == last) continue;
+        last = pc;
+        *out_pos++ = pc;
+        (*ncover)++;
+      }
+    }
+  } else if (flag_kcov && th->cov.usable && collect_comps) {
+    // KCOV_TRACE_CMP records: type, arg1, arg2, pc (4 u64 each)
+    uint64 n = __atomic_load_n(&th->cov.data[0], __ATOMIC_RELAXED);
+    for (uint64 i = 0; i < n && out_fits(4); i++) {
+      uint64* rec64 = &th->cov.data[1 + 4 * i];
+      memcpy(out_pos, &rec64[1], 8);
+      memcpy(out_pos + 2, &rec64[2], 8);
+      out_pos += 4;
+      (*ncomps)++;
+    }
+  } else if (flag_synthetic && (collect_signal || collect_cover)) {
+    // Deterministic fallback signal: two edges per (nr, errno) outcome.
+    // Keeps generation->exec->triage runnable with no KCOV (containers, CI).
+    uint32 s0 = hash32((uint32)th->nr * 2654435761u);
+    uint32 s1 = hash32(s0 ^ (uint32)th->err);
+    if (collect_signal && out_fits(2)) {
+      *out_pos++ = s0;
+      *out_pos++ = s1;
+      *nsig = 2;
+    }
+    if (collect_cover && out_fits(2)) {
+      *out_pos++ = s0;
+      *out_pos++ = s1;
+      *ncover = 2;
+    }
+  }
+  // commit
+  uint32* hdr = (uint32*)out_mem;
+  __atomic_store_n(hdr, hdr[0] + 1, __ATOMIC_RELEASE);
+}
+
+// ---------------- exec stream interpreter ---------------------------------
+
+struct parser_t {
+  uint64* words;
+  size_t nwords;
+  size_t pos = 0;
+  bool ok = true;
+
+  uint64 next() {
+    if (pos >= nwords) {
+      ok = false;
+      return kInstrEof;
+    }
+    return words[pos++];
+  }
+  uint64 peek() { return pos < nwords ? words[pos] : kInstrEof; }
+};
+
+// Reads one encoded arg; returns its value (for call args); for copyin,
+// writes to addr instead when addr != 0.
+static uint64 read_arg(parser_t* p, uint64 copyin_addr) {
+  uint64 kind = p->next();
+  switch (kind) {
+    case kArgConst: {
+      uint64 size = p->next();
+      uint64 val = p->next();
+      uint64 bf_off = p->next();
+      uint64 bf_len = p->next();
+      if (copyin_addr) {
+        NONFAILING({
+          char* a = (char*)copyin_addr;
+          if (bf_off == 0 && bf_len == 0) {
+            memcpy(a, &val, size > 8 ? 8 : size);
+          } else {
+            uint64 cur = 0;
+            memcpy(&cur, a, size > 8 ? 8 : size);
+            uint64 mask = ((bf_len < 64 ? (1ull << bf_len) : 0ull) - 1)
+                          << bf_off;
+            cur = (cur & ~mask) | ((val << bf_off) & mask);
+            memcpy(a, &cur, size > 8 ? 8 : size);
+          }
+        });
+      }
+      return val;
+    }
+    case kArgResult: {
+      uint64 size = p->next();
+      (void)size;
+      uint64 idx = p->next();
+      uint64 op_div = p->next();
+      uint64 op_add = p->next();
+      uint64 val = 0;
+      if (idx < kMaxInstr && results[idx].valid) val = results[idx].val;
+      if (op_div) val /= op_div;
+      val += op_add;
+      if (copyin_addr)
+        NONFAILING(memcpy((char*)copyin_addr, &val, size > 8 ? 8 : size));
+      return val;
+    }
+    case kArgData: {
+      uint64 size = p->next();
+      char* src = (char*)&p->words[p->pos];
+      p->pos += (size + 7) / 8;
+      if (copyin_addr) {
+        NONFAILING(memcpy((char*)copyin_addr, src, size));
+        return 0;
+      }
+      // Data as a direct syscall arg: pass a pointer to a scratch copy.
+      static __thread char scratch[4096];
+      uint64 n = size < sizeof(scratch) ? size : sizeof(scratch);
+      memcpy(scratch, src, n);
+      return (uint64)scratch;
+    }
+    case kArgCsum: {
+      // Checksums are computed by the serializer on the host in this build
+      // (prog/checksum semantics); consume and ignore chunk descriptors.
+      p->next();  // size
+      p->next();  // csum kind
+      uint64 nchunks = p->next();
+      for (uint64 i = 0; i < nchunks; i++) {
+        p->next();
+        p->next();
+        p->next();
+      }
+      return 0;
+    }
+    default:
+      p->ok = false;
+      return 0;
+  }
+}
+
+static void execute_one() {
+  memset(results, 0, sizeof(results));
+  out_reset();
+
+  parser_t p;
+  p.words = (uint64*)in_mem;
+  p.nwords = in_size / 8;
+
+  for (int pass = 0; pass < (flag_collide ? 2 : 1); pass++) {
+    bool colliding = pass == 1;
+    p.pos = 0;
+    uint64 instr_idx = 0;
+    int call_seq = 0;  // ordinal of the call within the program
+    int next_thread = 0;
+    thread_t* pair[2] = {nullptr, nullptr};
+    int pair_n = 0;
+
+    for (;;) {
+      uint64 w = p.peek();
+      if (!p.ok || w == kInstrEof) break;
+      if (w == kInstrCopyin) {
+        p.next();
+        uint64 addr = p.next();
+        read_arg(&p, addr);
+        instr_idx++;
+        continue;
+      }
+      if (w == kInstrCopyout) {
+        p.next();
+        uint64 addr = p.next();
+        uint64 size = p.next();
+        uint64 val = 0;
+        bool got = false;
+        NONFAILING({
+          memcpy(&val, (char*)addr, size > 8 ? 8 : size);
+          got = true;
+        });
+        if (!colliding && got && instr_idx < kMaxInstr) {
+          results[instr_idx].valid = true;
+          results[instr_idx].val = val;
+        }
+        instr_idx++;
+        continue;
+      }
+      // a syscall
+      uint64 call_id = p.next();
+      uint64 nargs = p.next();
+      uint64 args[kMaxArgs] = {};
+      for (uint64 i = 0; i < nargs; i++) {
+        uint64 v = read_arg(&p, 0);
+        if (i < kMaxArgs) args[i] = v;
+      }
+      uint64 nr = call_id < g_ncalls_table ? g_nr_table[call_id] : call_id;
+      int call_index = call_seq++;
+
+      if (!flag_threaded && !colliding) {
+        // serial inline execution on the main thread
+        thread_t* th = &threads[0];
+        th->call_index = call_index;
+        th->call_num = (int)call_id;
+        th->nr = nr;
+        memcpy(th->args, args, sizeof(args));
+        th->do_fault = fault_call == call_index && fault_nth >= 0;
+        th->fault_nth_local = fault_nth;
+        th->collect = true;
+        if (flag_kcov && !th->cov.usable && th->cov.fd == -1) kcov_open(&th->cov),
+            kcov_enable(&th->cov, collect_comps);
+        execute_call(th);
+        if (instr_idx < kMaxInstr) {
+          results[instr_idx].valid = true;
+          results[instr_idx].val = th->ret;
+        }
+        write_completion(th);
+      } else {
+        thread_t* th = &threads[next_thread % kMaxThreads];
+        next_thread++;
+        thread_start(th);
+        if (!wait_call(th, 0) && th->state != 0) {
+          // thread still busy from an earlier call; skip scheduling onto it
+          // (its eventual completion is not collected)
+        }
+        if (th->state == 0 || th->state == 3) {
+          th->state = 0;
+          th->call_index = call_index;
+          th->call_num = (int)call_id;
+          th->nr = nr;
+          memcpy(th->args, args, sizeof(args));
+          th->do_fault = !colliding && fault_call == call_index;
+          th->fault_nth_local = fault_nth;
+          th->collect = !colliding;
+          schedule_call(th);
+          if (!colliding) {
+            if (wait_call(th, kCallWaitMs)) {
+              if (instr_idx < kMaxInstr) {
+                results[instr_idx].valid = true;
+                results[instr_idx].val = th->ret;
+              }
+              write_completion(th);
+              th->state = 0;
+            }
+          } else {
+            // collide mode: issue pairs concurrently, wait only per pair
+            pair[pair_n++ % 2] = th;
+            if (pair_n % 2 == 0) {
+              wait_call(pair[0], kCallWaitMs);
+              wait_call(pair[1], kCallWaitMs);
+              if (pair[0]->state == 3) pair[0]->state = 0;
+              if (pair[1]->state == 3) pair[1]->state = 0;
+            }
+          }
+        }
+      }
+      instr_idx++;
+    }
+    if (colliding && pair_n % 2 == 1 && pair[0]) {
+      wait_call(pair[0], kCallWaitMs);
+      if (pair[0]->state == 3) pair[0]->state = 0;
+    }
+    // grace period for stragglers, collect late completions
+    if (flag_threaded && !colliding) {
+      for (int i = 0; i < kMaxThreads; i++) {
+        thread_t* th = &threads[i];
+        if (th->created && th->state != 0 && wait_call(th, kFinalWaitMs)) {
+          write_completion(th);
+          th->state = 0;
+        }
+      }
+    }
+  }
+}
+
+// ---------------- sandbox -------------------------------------------------
+
+static void sandbox_common() {
+  prctl(PR_SET_PDEATHSIG, SIGKILL);
+  setpgid(0, 0);
+  struct rlimit rlim;
+  rlim.rlim_cur = rlim.rlim_max = 8 << 20;
+  setrlimit(RLIMIT_FSIZE, &rlim);
+  rlim.rlim_cur = rlim.rlim_max = 256;
+  setrlimit(RLIMIT_NOFILE, &rlim);
+}
+
+static void do_sandbox(uint64 kind) {
+  // reference common_linux.h:686-880 (none / setuid / namespace)
+  sandbox_common();
+  if (kind == kEnvSandboxNamespace) {
+    // best-effort user+mount+net namespace isolation
+    if (unshare(CLONE_NEWUSER | CLONE_NEWNS | CLONE_NEWNET) == -1)
+      debug("unshare failed: %d\n", errno);
+  } else if (kind == kEnvSandboxSetuid) {
+    if (setresgid(65534, 65534, 65534) == -1) debug("setresgid failed\n");
+    if (setresuid(65534, 65534, 65534) == -1) debug("setresuid failed\n");
+  }
+}
+
+// ---------------- fork server ---------------------------------------------
+
+static void reply(uint64 status, uint64 exec_ns) {
+  reply_t r = {kReplyMagic, status, exec_ns};
+  if (write(STDOUT_FILENO, &r, sizeof(r)) != sizeof(r)) fail("reply write");
+}
+
+static int run_child(const req_t* req) {
+  // fresh private cwd per program (reference executor_linux.cc loop())
+  char dir[64];
+  snprintf(dir, sizeof(dir), "./syzexec-%d-%llu", g_pid,
+           (unsigned long long)now_ns());
+  if (mkdir(dir, 0777) == 0) {
+    if (chdir(dir)) debug("chdir failed\n");
+  }
+  install_segv_handler();
+  do_sandbox(flag_sandbox);
+  if (flag_premap) {
+    // map the whole data arena so programs need no leading mmap calls
+    void* want = (void*)g_data_offset;
+    void* got = mmap(want, g_num_pages * g_page_size,
+                     PROT_READ | PROT_WRITE,
+                     MAP_PRIVATE | MAP_ANONYMOUS | MAP_FIXED_NOREPLACE, -1, 0);
+    if (got != want) debug("arena premap failed\n");
+  }
+  execute_one();
+  return 0;
+}
+
+static void handle_exec(const req_t* req) {
+  uint64 ef = req->exec_flags;
+  collect_signal = ef & kExecCollectSignal;
+  collect_cover = ef & kExecCollectCover;
+  dedup_cover = ef & kExecDedupCover;
+  flag_threaded = ef & kExecThreaded;
+  flag_collide = ef & kExecCollide;
+  collect_comps = ef & kExecCollectComps;
+  if (ef & kExecInjectFault) {
+    fault_call = (int)((ef >> 32) & 0xffff);
+    fault_nth = (int)((ef >> 48) & 0xffff);
+  } else {
+    fault_call = -1;
+    fault_nth = 0;
+  }
+  out_reset();
+
+  uint64 t0 = now_ns();
+  pid_t child = fork();
+  if (child == -1) {
+    reply(kStatusFailed, 0);
+    return;
+  }
+  if (child == 0) {
+    _exit(run_child(req));
+  }
+  uint64 timeout_ms = req->timeout_ms ? req->timeout_ms : 5000;
+  uint64 deadline = t0 + timeout_ms * 1000000ull;
+  int status = 0;
+  bool done = false, hanged = false;
+  for (;;) {
+    pid_t r = waitpid(child, &status, WNOHANG);
+    if (r == child) {
+      done = true;
+      break;
+    }
+    if (now_ns() > deadline) {
+      hanged = true;
+      kill(-child, SIGKILL);
+      kill(child, SIGKILL);
+      waitpid(child, &status, 0);
+      break;
+    }
+    usleep(500);
+  }
+  uint64 ns = now_ns() - t0;
+  if (hanged)
+    reply(kStatusHanged, ns);
+  else if (done && WIFEXITED(status) && WEXITSTATUS(status) == 0)
+    reply(kStatusOk, ns);
+  else
+    reply(kStatusFailed, ns);
+}
+
+static void handle_handshake(const req_t* req) {
+  flag_debug = req->flags & kEnvDebug;
+  flag_kcov = req->flags & kEnvUseKcov;
+  flag_synthetic = req->flags & kEnvSyntheticCover;
+  flag_premap = req->flags & kEnvPremapArena;
+  flag_sandbox = req->flags & (kEnvSandboxSetuid | kEnvSandboxNamespace);
+  g_pid = (int)req->pid;
+
+  // table in in-shm: [ncalls, page_size, num_pages, data_offset, nr...]
+  uint64* tab = (uint64*)in_mem;
+  g_ncalls_table = tab[0];
+  g_page_size = tab[1];
+  g_num_pages = tab[2];
+  g_data_offset = tab[3];
+  if (g_ncalls_table > (in_size - 32) / 8) fail("bad handshake table");
+  free(g_nr_table);
+  g_nr_table = (uint64*)malloc(g_ncalls_table * 8);
+  memcpy(g_nr_table, tab + 4, g_ncalls_table * 8);
+  debug("handshake: %llu calls, page=%llu pages=%llu arena=0x%llx\n",
+        (unsigned long long)g_ncalls_table, (unsigned long long)g_page_size,
+        (unsigned long long)g_num_pages, (unsigned long long)g_data_offset);
+  reply(kStatusOk, 0);
+}
+
+int main(int argc, char** argv) {
+  if (argc != 3) {
+    fprintf(stderr, "usage: executor <in_file> <out_file>\n");
+    return 64;
+  }
+  int in_fd = open(argv[1], O_RDWR);
+  int out_fd = open(argv[2], O_RDWR);
+  if (in_fd == -1 || out_fd == -1) fail("open shm files");
+  struct stat st;
+  fstat(in_fd, &st);
+  in_size = st.st_size;
+  fstat(out_fd, &st);
+  out_size = st.st_size;
+  in_mem = (char*)mmap(nullptr, in_size, PROT_READ | PROT_WRITE, MAP_SHARED,
+                       in_fd, 0);
+  out_mem = (char*)mmap(nullptr, out_size, PROT_READ | PROT_WRITE, MAP_SHARED,
+                        out_fd, 0);
+  if (in_mem == MAP_FAILED || out_mem == MAP_FAILED) fail("mmap shm");
+  signal(SIGPIPE, SIG_IGN);
+
+  for (;;) {
+    req_t req;
+    ssize_t n = read(STDIN_FILENO, &req, sizeof(req));
+    if (n == 0) break;  // parent closed the pipe
+    if (n != sizeof(req) || req.magic != kReqMagic) fail("bad request");
+    switch (req.cmd) {
+      case kCmdHandshake:
+        handle_handshake(&req);
+        break;
+      case kCmdExec:
+        handle_exec(&req);
+        break;
+      case kCmdQuit:
+        return 0;
+      default:
+        fail("unknown command");
+    }
+  }
+  return 0;
+}

@@ -1,0 +1,51 @@
+"""Build the C++ executor binary, cached by source hash.
+
+The reference ships a Makefile target for executor/executor_linux.cc; here
+the ipc layer builds on demand so tests and tools are self-contained.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+from pathlib import Path
+
+_SRC = Path(__file__).resolve().parent.parent / "executor" / "executor.cc"
+_BUILD_DIR = Path(__file__).resolve().parent.parent / "executor" / "build"
+
+
+class BuildError(RuntimeError):
+    pass
+
+
+def executor_source() -> Path:
+    return _SRC
+
+
+def build_executor(cxx: str = "g++", force: bool = False) -> Path:
+    """Compile executor.cc -> build/syz-executor-<hash8>; returns the path.
+
+    Hash-keyed caching: recompiles only when the source changes.
+    """
+    src = _SRC.read_bytes()
+    h = hashlib.sha256(src).hexdigest()[:8]
+    out = _BUILD_DIR / f"syz-executor-{h}"
+    if out.exists() and not force:
+        return out
+    _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    tmp = out.with_suffix(".tmp")
+    cmd = [cxx, "-O2", "-std=c++17", "-Wall", "-Wno-unused-result",
+           "-pthread", str(_SRC), "-o", str(tmp)]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise BuildError(f"executor build failed:\n{proc.stderr}")
+    os.replace(tmp, out)
+    # drop stale binaries from previous source revisions
+    for old in _BUILD_DIR.glob("syz-executor-*"):
+        if old != out and not old.name.endswith(".tmp"):
+            try:
+                old.unlink()
+            except OSError:
+                pass
+    return out

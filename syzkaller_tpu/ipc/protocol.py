@@ -1,0 +1,40 @@
+"""Wire constants shared with the C++ executor (executor/executor.cc).
+
+Keep in sync by hand; tests/test_ipc.py round-trips real executions through
+the compiled binary, which catches any skew.
+"""
+
+REQ_MAGIC = 0x73797A74707500AA
+REPLY_MAGIC = 0x73797A74707500BB
+
+CMD_HANDSHAKE = 1
+CMD_EXEC = 2
+CMD_QUIT = 3
+
+# env flags (handshake)
+ENV_DEBUG = 1 << 0
+ENV_USE_KCOV = 1 << 1
+ENV_SANDBOX_SETUID = 1 << 2
+ENV_SANDBOX_NAMESPACE = 1 << 3
+ENV_SYNTHETIC_COVER = 1 << 4
+ENV_PREMAP_ARENA = 1 << 5
+
+# exec flags
+EXEC_COLLECT_SIGNAL = 1 << 0
+EXEC_COLLECT_COVER = 1 << 1
+EXEC_DEDUP_COVER = 1 << 2
+EXEC_THREADED = 1 << 3
+EXEC_COLLIDE = 1 << 4
+EXEC_COLLECT_COMPS = 1 << 5
+EXEC_INJECT_FAULT = 1 << 6
+
+STATUS_OK = 0
+STATUS_FAILED = 1
+STATUS_HANGED = 2
+
+# call record flags
+CALL_EXECUTED = 1 << 0
+CALL_FAULT_INJECTED = 1 << 1
+
+IN_SHM_SIZE = 2 << 20    # reference pkg/ipc/ipc.go:36 — 2MB in
+OUT_SHM_SIZE = 16 << 20  # 16MB out

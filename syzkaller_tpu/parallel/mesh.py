@@ -31,7 +31,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.dtables import DeviceTables
 from ..ops import mutation as dmut
-from .collective import or_all_reduce
 
 AXIS_FUZZ = "fuzz"
 AXIS_COVER = "cover"
@@ -46,6 +45,9 @@ def make_mesh(n_devices: Optional[int] = None, n_cover: Optional[int] = None,
     if devices is None:
         devices = jax.devices()
     if n_devices is not None:
+        if len(devices) < n_devices:
+            raise ValueError(
+                f"mesh wants {n_devices} devices, only {len(devices)} visible")
         devices = devices[:n_devices]
     n = len(devices)
     if n_cover is None:
@@ -88,17 +90,20 @@ def call_fingerprints(cid, sval) -> jnp.ndarray:
 # sharded signal bitset ops (word-range sharded over AXIS_COVER)
 
 
-def _shard_hits(sig_shard, sigs, shard_idx):
-    """bitset_test against this device's word range; sigs outside the
-    range report False here and are answered by the owning shard."""
+def _shard_index(sig_shard, sigs, shard_idx, n_shards):
+    """The single canonical bitset mapping (ops/cover.py:_index — low bits
+    of the mixed signal, power-of-two table) applied to this device's word
+    range [shard_idx*W, (shard_idx+1)*W). Returns (mine, local_word, bit)."""
     w = sig_shard.shape[0]
+    nbits_total = w * n_shards * 32
+    assert nbits_total & (nbits_total - 1) == 0, \
+        f"sharded bitset must be power-of-two total bits, got {nbits_total}"
     h = jnp.asarray(sigs, U32)
-    word = (h >> 5) % jnp.uint32(w * jax.lax.psum(1, AXIS_COVER))
+    masked = h & U32(nbits_total - 1)
+    word = masked >> 5
     lo = jnp.uint32(shard_idx * w)
     mine = (word >= lo) & (word < lo + jnp.uint32(w)) & (h != SENT)
-    lw = jnp.where(mine, word - lo, 0)
-    hit = (sig_shard[lw] >> (h & U32(31))) & U32(1)
-    return mine, (hit == 1) & mine
+    return mine, jnp.where(mine, word - lo, 0), (masked & U32(31))
 
 
 def fold_signals(sig_shard, sigs):
@@ -108,20 +113,17 @@ def fold_signals(sig_shard, sigs):
     before anywhere).  Distributed SignalNew + SignalAdd
     (/root/reference/pkg/cover/cover.go:160-182)."""
     j = jax.lax.axis_index(AXIS_COVER)
+    n_shards = jax.lax.psum(1, AXIS_COVER)
     # --- test: per-shard hits, then combine over the cover axis ---
-    mine, hit = _shard_hits(sig_shard, sigs, j)
+    mine, lw, bit = _shard_index(sig_shard, sigs, j, n_shards)
+    hit = ((sig_shard[lw] >> bit) & U32(1)) == 1
     fresh_local = jnp.any(mine & ~hit, axis=-1)
     fresh = jax.lax.psum(fresh_local.astype(jnp.int32), AXIS_COVER) > 0
     # --- fold: gather every fuzz-shard's signals, scatter my range ---
     allsigs = jax.lax.all_gather(sigs, AXIS_FUZZ).reshape(-1)
-    w = sig_shard.shape[0]
-    h = jnp.asarray(allsigs, U32)
-    word = (h >> 5) % jnp.uint32(w * jax.lax.psum(1, AXIS_COVER))
-    lo = jnp.uint32(j * w)
-    mine_all = (word >= lo) & (word < lo + jnp.uint32(w)) & (h != SENT)
-    lw = jnp.where(mine_all, word - lo, 0)
-    mask = jnp.where(mine_all, U32(1) << (h & U32(31)), U32(0))
-    sig_shard = jnp.bitwise_or.at(sig_shard, lw, mask, inplace=False)
+    mine_all, lw_all, bit_all = _shard_index(sig_shard, allsigs, j, n_shards)
+    mask = jnp.where(mine_all, U32(1) << bit_all, U32(0))
+    sig_shard = jnp.bitwise_or.at(sig_shard, lw_all, mask, inplace=False)
     return sig_shard, fresh
 
 
