@@ -1,0 +1,159 @@
+"""Corpus database: append-only compacting compressed key-value store.
+
+Capability parity with reference /root/reference/pkg/db/db.go:25-120
+(corpus.db): crash-safe appends, tombstone deletes, automatic compaction
+when the dead-record ratio grows. The corpus *is* the fuzzer's checkpoint
+(SURVEY.md §5 checkpoint/resume), so records must survive torn writes: each
+record is length-prefixed + CRC'd and a truncated tail is dropped on open.
+
+Format: 16-byte header `SYZTPUDB` + u32 version + u32 reserved, then
+records: u8 op (0=save, 1=delete), u32 klen, u32 vlen, u32 crc32(payload),
+key bytes, zlib(value) bytes.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from typing import Dict, Iterator, Optional, Tuple
+
+_MAGIC = b"SYZTPUDB"
+_VERSION = 1
+_HDR = struct.Struct("<8sII")
+_REC = struct.Struct("<BIII")
+
+OP_SAVE = 0
+OP_DELETE = 1
+
+
+class DB:
+    """Open with `DB.open(path)`; mutate with save/delete; `flush()` fsyncs.
+    `compact()` rewrites the log dropping dead records; it runs automatically
+    on open when more than half the records are dead."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.records: Dict[bytes, bytes] = {}
+        self._file = None
+        self._total = 0  # appended records since last compaction
+
+    # ---- lifecycle ----
+
+    @classmethod
+    def open(cls, path: str) -> "DB":
+        db = cls(path)
+        fresh = not os.path.exists(path) or os.path.getsize(path) < _HDR.size
+        if not fresh:
+            db._read_log()
+        if db._total > 2 * max(len(db.records), 1):
+            db.compact()
+        else:
+            db._file = open(path, "ab")
+            if fresh:
+                db._file.write(_HDR.pack(_MAGIC, _VERSION, 0))
+                db._file.flush()
+        return db
+
+    def close(self) -> None:
+        if self._file:
+            self._file.close()
+            self._file = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ---- reads ----
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self.records.get(key)
+
+    def __contains__(self, key: bytes) -> bool:
+        return key in self.records
+
+    def __len__(self) -> int:
+        return len(self.records)
+
+    def items(self) -> Iterator[Tuple[bytes, bytes]]:
+        return iter(self.records.items())
+
+    # ---- writes ----
+
+    def save(self, key: bytes, value: bytes) -> None:
+        self.records[key] = value
+        self._append(OP_SAVE, key, value)
+        self._total += 1
+
+    def delete(self, key: bytes) -> None:
+        if key not in self.records:
+            return
+        del self.records[key]
+        self._append(OP_DELETE, key, b"")
+        self._total += 1
+
+    def flush(self) -> None:
+        if self._file:
+            self._file.flush()
+            os.fsync(self._file.fileno())
+
+    def compact(self) -> None:
+        """Rewrite the log with only live records (atomic rename)."""
+        if self._file:
+            self._file.close()
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(_HDR.pack(_MAGIC, _VERSION, 0))
+            for k, v in self.records.items():
+                f.write(self._encode(OP_SAVE, k, v))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        self._file = open(self.path, "ab")
+        self._total = len(self.records)
+
+    # ---- log I/O ----
+
+    @staticmethod
+    def _encode(op: int, key: bytes, value: bytes) -> bytes:
+        blob = zlib.compress(value) if op == OP_SAVE else b""
+        payload = key + blob
+        return _REC.pack(op, len(key), len(blob),
+                         zlib.crc32(payload) & 0xFFFFFFFF) + payload
+
+    def _append(self, op: int, key: bytes, value: bytes) -> None:
+        if self._file is None:
+            self._file = open(self.path, "ab")
+        self._file.write(self._encode(op, key, value))
+
+    def _read_log(self) -> None:
+        with open(self.path, "rb") as f:
+            data = f.read()
+        if len(data) < _HDR.size:
+            return
+        magic, version, _ = _HDR.unpack_from(data, 0)
+        if magic != _MAGIC or version != _VERSION:
+            raise ValueError(f"{self.path}: not a corpus db")
+        pos = _HDR.size
+        while pos + _REC.size <= len(data):
+            op, klen, vlen, crc = _REC.unpack_from(data, pos)
+            end = pos + _REC.size + klen + vlen
+            if end > len(data):
+                break  # torn tail from a crash mid-append: drop it
+            payload = data[pos + _REC.size:end]
+            if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                break
+            key, blob = payload[:klen], payload[klen:]
+            if op == OP_SAVE:
+                try:
+                    self.records[key] = zlib.decompress(blob)
+                except zlib.error:
+                    break
+            elif op == OP_DELETE:
+                self.records.pop(key, None)
+            else:
+                break
+            self._total += 1
+            pos = end

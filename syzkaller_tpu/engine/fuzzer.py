@@ -1,0 +1,431 @@
+"""The fuzzer brain: corpus, signal bookkeeping, triage/smash pipeline,
+and the TPU candidate generator.
+
+Role parity with reference /root/reference/syz-fuzzer/fuzzer.go:98-428
+(proc loop, work-queue priorities, signal sets, triageInput:521-625,
+smashInput:491-519), re-architected for the device: instead of one
+mutation per loop iteration, candidates arrive in device-mutated *batches*
+(ops/mutation.py) decoded through the tensor codec, and new-signal testing
+against the accumulated max-signal runs as a packed-bitset gather
+(ops/cover.py) — the BASELINE.json north-star path. Execution stays on the
+CPU executor fleet through ipc.Env; a MockEnv makes the whole loop
+hermetic.
+
+Signal bookkeeping (fuzzer.go:65-68):
+  corpus_signal — signal present in the corpus (exact host sets)
+  max_signal   — everything ever seen (host set + device bitset mirror)
+  new_signal   — delta not yet reported to the manager
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set
+
+from ..ipc import CallInfo, Env, EnvConfig, ExecOpts, MockEnv
+from ..prog.analysis import assign_sizes_call
+from ..prog.encoding import serialize
+from ..prog.generation import RandGen, generate
+from ..prog.hints import CompMap, mutate_with_hints
+from ..prog.mutation import minimize, mutate
+from ..prog.prio import build_choice_table
+from ..prog.prog import Prog
+from ..utils.hash import hash_str
+from .queue import CandidateItem, SmashItem, TriageItem, WorkQueue
+
+
+@dataclass
+class FuzzerConfig:
+    procs: int = 1
+    program_length: int = 16
+    mock: bool = False                  # MockEnv instead of real executor
+    use_device: bool = True             # TPU/JAX batched candidate path
+    device_batch: int = 256
+    generate_period: int = 100          # 1 generation per N mutations
+    smash_mutations: int = 100          # reference fuzzer.go:498
+    triage_reruns: int = 3              # reference fuzzer.go:540
+    fault_injection: bool = False
+    collect_comps: bool = False
+    sandbox: str = "none"
+    device_period: int = 16             # consume a device batch every N steps
+    env_config: Optional[EnvConfig] = None
+
+
+class ManagerConn:
+    """Interface the engine talks to (reference rpctype Manager.*). The
+    in-process default just accumulates; manager/rpc.py provides the real
+    TCP client with identical methods."""
+
+    def connect(self):
+        return {"corpus": [], "prios": None, "max_signal": [],
+                "candidates": [], "enabled": None}
+
+    def new_input(self, prog_text: str, call_index: int,
+                  signal: Sequence[int], cover: Sequence[int]) -> None:
+        pass
+
+    def poll(self, stats: Dict[str, int], need_candidates: bool,
+             new_signal: Sequence[int] = ()):
+        return {"new_inputs": [], "candidates": [], "max_signal": []}
+
+
+class Fuzzer:
+    def __init__(self, target, config: Optional[FuzzerConfig] = None,
+                 manager: Optional[ManagerConn] = None, seed: int = 0):
+        self.target = target
+        self.cfg = config or FuzzerConfig()
+        self.manager = manager or ManagerConn()
+        self.rng = RandGen(target, seed=seed)
+        self.queue = WorkQueue()
+        self.stats: Dict[str, int] = {
+            "exec_total": 0, "exec_gen": 0, "exec_fuzz": 0,
+            "exec_candidate": 0, "exec_triage": 0, "exec_minimize": 0,
+            "exec_smash": 0, "exec_hints": 0, "new_inputs": 0,
+            "device_batches": 0, "device_candidates": 0,
+        }
+        self.corpus: List[Prog] = []
+        self.corpus_hashes: Set[str] = set()
+        self.corpus_signal: Set[int] = set()
+        self.max_signal: Set[int] = set()
+        self.new_signal: Set[int] = set()
+        self._lock = threading.Lock()
+
+        conn = self.manager.connect()
+        self._enabled = conn.get("enabled")
+        self.choice_table = build_choice_table(
+            target, conn.get("prios"), self._enabled)
+        self.max_signal.update(conn.get("max_signal", ()))
+        for text in conn.get("corpus", ()):
+            self._add_corpus_text(text)
+        for text in conn.get("candidates", ()):
+            self._push_candidate_text(text)
+
+        self.envs: List = []
+        for pid in range(self.cfg.procs):
+            if self.cfg.mock:
+                self.envs.append(MockEnv(target, pid=pid))
+            else:
+                ec = self.cfg.env_config or EnvConfig(sandbox=self.cfg.sandbox)
+                self.envs.append(Env(target, pid=pid, config=ec))
+
+        self._device = None
+        if self.cfg.use_device:
+            try:
+                self._device = _DevicePipeline(target, self.cfg)
+            except Exception:
+                self._device = None  # no jax available: host-only mode
+
+        self._iter = 0
+
+    # ---- lifecycle ----
+
+    def close(self) -> None:
+        for e in self.envs:
+            e.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ---- corpus ----
+
+    def _add_corpus_text(self, text: str) -> None:
+        from ..prog.encoding import deserialize
+
+        try:
+            p = deserialize(self.target, text)
+        except Exception:
+            return
+        self._add_corpus(p, ())
+
+    def _push_candidate_text(self, text: str) -> None:
+        from ..prog.encoding import deserialize
+
+        try:
+            p = deserialize(self.target, text)
+        except Exception:
+            return
+        self.queue.push_candidate(CandidateItem(p))
+
+    def _add_corpus(self, p: Prog, signal: Sequence[int]) -> bool:
+        h = hash_str(serialize(p).encode())
+        with self._lock:
+            if h in self.corpus_hashes:
+                return False
+            self.corpus_hashes.add(h)
+            self.corpus.append(p)
+            self.corpus_signal.update(signal)
+        if self._device is not None:
+            self._device.add_corpus(p)
+        return True
+
+    # ---- signal algebra (reference cover.SignalNew / SignalDiff) ----
+
+    def _signal_new(self, sig: Sequence[int]) -> bool:
+        return any(s not in self.max_signal for s in sig)
+
+    def _signal_diff(self, sig: Sequence[int]) -> List[int]:
+        return [s for s in sig if s not in self.max_signal]
+
+    def _note_signal(self, sig: Sequence[int]) -> None:
+        fresh = [s for s in sig if s not in self.max_signal]
+        if fresh:
+            self.max_signal.update(fresh)
+            self.new_signal.update(fresh)
+
+    # ---- execution ----
+
+    def execute(self, p: Prog, stat: str = "exec_fuzz",
+                opts: Optional[ExecOpts] = None, pid: int = 0,
+                scan_new: bool = True) -> List[CallInfo]:
+        """scan_new=False is the reference's executeRaw path
+        (fuzzer.go:698): triage re-runs and minimize predicates must not
+        re-enqueue triage work for the program's other calls."""
+        opts = opts or ExecOpts()
+        env = self.envs[pid % len(self.envs)]
+        _, infos, failed, hanged = env.exec(opts, p)
+        self.stats["exec_total"] += 1
+        self.stats[stat] = self.stats.get(stat, 0) + 1
+        if failed or hanged or not scan_new:
+            return infos
+        # check per-call signal for novelty -> triage
+        for info in infos:
+            if info.index >= len(p.calls):
+                continue
+            diff = self._signal_diff(info.signal)
+            if diff:
+                self.queue.push_triage(TriageItem(
+                    prog=p.clone(), call_index=info.index, signal=diff))
+        return infos
+
+    # ---- triage (reference triageInput fuzzer.go:521-625) ----
+
+    def triage(self, item: TriageItem) -> None:
+        opts = ExecOpts(collect_signal=True, collect_cover=True)
+        inter: Optional[Set[int]] = None
+        cover: Set[int] = set()
+        for _ in range(self.cfg.triage_reruns):
+            infos = self.execute(item.prog, "exec_triage", opts,
+                                 scan_new=False)
+            sig = self._call_signal(infos, item.call_index)
+            if sig is None:
+                continue
+            cover.update(self._call_cover(infos, item.call_index) or ())
+            inter = set(sig) if inter is None else (inter & set(sig))
+            if not inter:
+                return  # flaky signal: drop
+        if not inter:
+            return
+        relevant = inter & set(item.signal) if item.signal else inter
+        if item.signal and not relevant:
+            return
+
+        def pred(p: Prog, call_index: int) -> bool:
+            infos = self.execute(p, "exec_minimize", opts, scan_new=False)
+            sig = self._call_signal(infos, call_index)
+            return sig is not None and relevant.issubset(set(sig))
+
+        if not item.minimized:
+            item.prog, item.call_index = minimize(
+                item.prog, item.call_index, pred)
+
+        sig_list = sorted(inter)
+        self._note_signal(sig_list)
+        if not self._add_corpus(item.prog, sig_list):
+            return  # minimized to an already-known program
+        self.stats["new_inputs"] += 1
+        self.manager.new_input(serialize(item.prog), item.call_index,
+                               sig_list, sorted(cover))
+        self.queue.push_smash(SmashItem(item.prog, item.call_index))
+
+    @staticmethod
+    def _call_signal(infos: List[CallInfo], call_index: int
+                     ) -> Optional[List[int]]:
+        for info in infos:
+            if info.index == call_index:
+                return info.signal
+        return None
+
+    @staticmethod
+    def _call_cover(infos: List[CallInfo], call_index: int
+                    ) -> Optional[List[int]]:
+        for info in infos:
+            if info.index == call_index:
+                return info.cover
+        return None
+
+    # ---- smash (reference smashInput fuzzer.go:491-519) ----
+
+    def smash(self, item: SmashItem) -> None:
+        if self.cfg.collect_comps:
+            self._hints_seed(item)
+        if self.cfg.fault_injection and item.call_index >= 0:
+            self._fail_call(item.prog, item.call_index)
+        for i in range(self.cfg.smash_mutations):
+            p = item.prog.clone()
+            mutate(p, self.rng, self.cfg.program_length,
+                   ct=self.choice_table, corpus=self.corpus)
+            self.execute(p, "exec_smash")
+
+    def _fail_call(self, p: Prog, call_index: int) -> None:
+        for nth in range(100):  # 0-based; executor adds 1
+            opts = ExecOpts(fault_call=call_index, fault_nth=nth)
+            infos = self.execute(p, "exec_smash", opts)
+            info = next((i for i in infos if i.index == call_index), None)
+            if info is None or not info.fault_injected:
+                break
+
+    def _hints_seed(self, item: SmashItem) -> None:
+        """reference executeHintSeed (fuzzer.go:627): exec with comps,
+        then exec every hint mutant."""
+        opts = ExecOpts(collect_signal=False, collect_comps=True)
+        infos = self.execute(item.prog, "exec_hints", opts)
+        comp_maps = []
+        for i in range(len(item.prog.calls)):
+            info = next((x for x in infos if x.index == i), None)
+            comp_maps.append(CompMap.from_pairs(info.comps if info else ()))
+        mutate_with_hints(item.prog, comp_maps,
+                          lambda p: self.execute(p, "exec_hints"))
+
+    # ---- the loop ----
+
+    def step(self) -> None:
+        """One scheduling decision (one iteration of the reference's
+        proc loop, fuzzer.go:256-328)."""
+        self._iter += 1
+        # The TPU candidate factory runs on a fixed cadence regardless of
+        # queue pressure — it is the primary fuzz source, double-buffered so
+        # a batch is always cooking while the fleet executes the last one.
+        if (self._device is not None and self.corpus
+                and self._iter % self.cfg.device_period == 0):
+            batch = self._device.candidates(self.corpus)
+            if batch:
+                self.stats["device_batches"] += 1
+                self.stats["device_candidates"] += len(batch)
+                for p in batch:
+                    self.execute(p, "exec_fuzz")
+                return
+        item = self.queue.pop()
+        if isinstance(item, TriageItem):
+            self.triage(item)
+            return
+        if isinstance(item, CandidateItem):
+            self.execute(item.prog, "exec_candidate")
+            return
+        if isinstance(item, SmashItem):
+            self.smash(item)
+            return
+        if not self.corpus or self._iter % self.cfg.generate_period == 0:
+            p = generate(self.target, self.rng, self.cfg.program_length,
+                         self.choice_table)
+            self.execute(p, "exec_gen")
+        else:
+            p = self.corpus[self.rng.intn(len(self.corpus))].clone()
+            mutate(p, self.rng, self.cfg.program_length,
+                   ct=self.choice_table, corpus=self.corpus)
+            self.execute(p, "exec_fuzz")
+
+    def loop(self, iterations: int = 0, duration: float = 0.0) -> None:
+        t0 = time.time()
+        i = 0
+        while True:
+            if iterations and i >= iterations:
+                break
+            if duration and time.time() - t0 >= duration:
+                break
+            self.step()
+            i += 1
+
+    def poll_manager(self) -> None:
+        """Exchange stats/new-signal with the manager (fuzzer.go:334-427)."""
+        stats = dict(self.stats)
+        r = self.manager.poll(stats, need_candidates=not self.corpus,
+                              new_signal=sorted(self.new_signal))
+        for text in r.get("new_inputs", ()):
+            self._add_corpus_text(text)
+        for text in r.get("candidates", ()):
+            self._push_candidate_text(text)
+        self.max_signal.update(r.get("max_signal", ()))
+        self.new_signal.clear()
+
+
+class _DevicePipeline:
+    """Device-side candidate factory: keeps an encoded mirror of the corpus
+    and emits batches of device-mutated candidates, double-buffered so the
+    TPU mutates batch N+1 while the executor fleet runs batch N (SURVEY §7
+    hard part #3)."""
+
+    def __init__(self, target, cfg: FuzzerConfig):
+        import jax
+
+        from ..descriptions.tables import get_tables
+        from ..ops.dtables import build_device_tables
+        from ..ops import mutation as dmut
+        from ..prog.tensor import ProgBatch, TensorFormat, encode_prog
+
+        self._jax = jax
+        self._dmut = dmut
+        self.tables = get_tables(target)
+        self.fmt = TensorFormat.for_tables(
+            self.tables, max_calls=cfg.program_length)
+        self.dt = build_device_tables(self.tables, self.fmt)
+        self.B = cfg.device_batch
+        self._ProgBatch = ProgBatch
+        self._encode_prog = encode_prog
+        self._key = jax.random.PRNGKey(1)
+        self._pick = __import__("numpy").random.default_rng(1)
+        self._pending = None  # in-flight device computation (double buffer)
+        self.target = target
+        self._corpus_encoded: List = []
+
+    def add_corpus(self, p: Prog) -> None:
+        batch = self._ProgBatch.empty(self.fmt, 1)
+        try:
+            self._encode_prog(self.tables, self.fmt, p, batch, 0)
+        except Exception:
+            return  # long-tail arg the tensor format can't carry yet
+        self._corpus_encoded.append(
+            (batch.call_id[0], batch.slot_val[0], batch.data[0]))
+
+    def _launch(self):
+        import numpy as np
+
+        jax = self._jax
+        n = len(self._corpus_encoded)
+        if n == 0:
+            return None
+        self._key, kmut = jax.random.split(self._key)
+        idx = self._pick.integers(0, n, size=self.B)
+        cid = np.stack([self._corpus_encoded[i][0] for i in idx])
+        sval = np.stack([self._corpus_encoded[i][1] for i in idx])
+        data = np.stack([self._corpus_encoded[i][2] for i in idx])
+        return self._dmut.mutate_batch(kmut, self.dt, cid, sval, data)
+
+    def candidates(self, corpus: List[Prog]) -> List[Prog]:
+        """Return the previously launched batch (decoded) and launch the
+        next one."""
+        from ..prog.tensor import decode_prog
+
+        import numpy as np
+
+        done = self._pending
+        self._pending = self._launch()
+        if done is None:
+            return []
+        cid, sval, data = (np.asarray(x) for x in done)
+        batch = self._ProgBatch(call_id=cid, slot_val=sval, data=data)
+        out: List[Prog] = []
+        for i in range(cid.shape[0]):
+            try:
+                p = decode_prog(self.tables, self.fmt, batch, i)
+            except Exception:
+                continue
+            for c in p.calls:
+                self.target.sanitize_call(c)
+                assign_sizes_call(self.target, c)
+            out.append(p)
+        return out

@@ -1,0 +1,76 @@
+"""Prioritized fuzzing work queues (reference /root/reference/syz-fuzzer/
+fuzzer.go:74-78,261-306: triageCandidate > candidate > triage > smash)."""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..ipc import CallInfo
+from ..prog.prog import Prog
+
+
+@dataclass
+class TriageItem:
+    prog: Prog
+    call_index: int
+    signal: List[int]
+    from_candidate: bool = False
+    minimized: bool = False
+
+
+@dataclass
+class CandidateItem:
+    prog: Prog
+    minimized: bool = False
+
+
+@dataclass
+class SmashItem:
+    prog: Prog
+    call_index: int = -1
+
+
+class WorkQueue:
+    """Thread-safe priority-ordered queues. Pop order: candidate triage,
+    candidates, triage, smash — starving smash work when triage backs up,
+    exactly the reference's proc-loop priority ladder."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._triage_candidate: deque = deque()
+        self._candidate: deque = deque()
+        self._triage: deque = deque()
+        self._smash: deque = deque()
+
+    def push_triage(self, item: TriageItem) -> None:
+        with self._lock:
+            (self._triage_candidate if item.from_candidate
+             else self._triage).append(item)
+
+    def push_candidate(self, item: CandidateItem) -> None:
+        with self._lock:
+            self._candidate.append(item)
+
+    def push_smash(self, item: SmashItem) -> None:
+        with self._lock:
+            self._smash.append(item)
+
+    def pop(self):
+        with self._lock:
+            for q in (self._triage_candidate, self._candidate,
+                      self._triage, self._smash):
+                if q:
+                    return q.popleft()
+        return None
+
+    def depths(self):
+        with self._lock:
+            return {
+                "triage_candidate": len(self._triage_candidate),
+                "candidate": len(self._candidate),
+                "triage": len(self._triage),
+                "smash": len(self._smash),
+            }

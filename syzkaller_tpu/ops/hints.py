@@ -1,0 +1,120 @@
+"""Batched comparison-hint matching on device.
+
+The host semantics live in prog/hints.py (reference
+/root/reference/prog/hints.go). Here the whole workflow is a data-parallel
+join, which is exactly what the TPU is good at: the executor reports
+hundreds of thousands of comparison pairs per smash batch, and every
+(argument value, comparison) pair is tested at once:
+
+    variants:  each arg value expands to its 7 cast variants
+               (u8/u16/u32 truncations, their sign-extensions, u64)
+    join:      variants [M, 7] == comp ops [N]  ->  [M, 7, N] mask
+               (broadcast compare; XLA fuses the whole thing into one
+               elementwise kernel, no host loop over comparisons)
+    splice:    matched comparand low bits replace the arg's low bits
+
+Output is a dense (mask, replacer) matrix the host turns into hint mutants
+(top-K per site), or the engine applies directly to tensor programs.
+"""
+
+from __future__ import annotations
+
+from . import ensure_x64  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+U64 = jnp.uint64
+
+# (width, signed-extend) pairs: u8/u16/u32 zero + sign, then full u64
+_WIDTHS = (8, 16, 32)
+NUM_VARIANTS = 2 * len(_WIDTHS) + 1
+
+
+def cast_variants(vals):
+    """[...,] u64 -> ([..., 7] variant values, [7] widths).
+
+    Variant k for k<3: zero-truncation to 8/16/32 bits; k in 3..5: the
+    sign-extended form (only meaningful when the sign bit of that width is
+    set — emitted as the truncation otherwise, which dedups downstream);
+    k=6: the value itself (prog/hints.py shrink_expand, reference
+    hints.go:150-160)."""
+    v = jnp.asarray(vals, U64)
+    outs = []
+    widths = []
+    for w in _WIDTHS:
+        mask = U64((1 << w) - 1)
+        outs.append(v & mask)
+        widths.append(w)
+    for w in _WIDTHS:
+        mask = U64((1 << w) - 1)
+        sign = (v >> U64(w - 1)) & U64(1)
+        ext = v | ~mask
+        outs.append(jnp.where(sign == 1, ext, v & mask))
+        widths.append(w)
+    outs.append(v)
+    widths.append(64)
+    return jnp.stack(outs, axis=-1), np.asarray(widths, np.uint32)
+
+
+def hint_matrix(arg_vals, comp_ops, comp_args, special_ints):
+    """The batched join.
+
+    arg_vals:  [M] u64   argument values observed in the program
+    comp_ops:  [N] u64   comparison first operands (what the kernel saw)
+    comp_args: [N] u64   comparison second operands (what it compared to)
+    special_ints: [S] u64 values to skip (generator already tries them)
+
+    Returns (ok [M, 7, N] bool, replacer [M, 7, N] u64): for every
+    (site, cast variant, comparison) the spliced replacement value and
+    whether it is a valid hint (operand matched, comparand fits the cast
+    width, not special, actually changes the value)."""
+    av = jnp.asarray(arg_vals, U64)
+    ops = jnp.asarray(comp_ops, U64)
+    cargs = jnp.asarray(comp_args, U64)
+    special = jnp.asarray(special_ints, U64)
+
+    variants, widths = cast_variants(av)          # [M, 7]
+    wmask = (jnp.where(
+        jnp.asarray(widths) == 64,
+        jnp.full((), 0xFFFFFFFFFFFFFFFF, U64),
+        (U64(1) << jnp.asarray(widths, U64)) - U64(1)))  # [7]
+
+    m = variants[:, :, None] == ops[None, None, :]          # [M,7,N] matched
+    hi = cargs[None, None, :] & ~wmask[None, :, None]
+    fits = (hi == 0) | (hi == (~wmask[None, :, None]))      # comparand fits
+    low = cargs[None, None, :] & wmask[None, :, None]
+    is_special = jnp.any(low[..., None] == special[None, None, None, :],
+                         axis=-1)
+    replacer = (av[:, None, None] & ~wmask[None, :, None]) | low
+    ok = m & fits & ~is_special & (replacer != av[:, None, None])
+    return ok, replacer
+
+
+def unique_replacers(ok, replacer, max_out: int):
+    """Flatten per-site hints to a padded [M, max_out] u64 with validity
+    mask, deduplicating within each site. Sites produce hints in comp-table
+    order; overflow beyond max_out is dropped (mirrors the reference's
+    implicit cap via set iteration)."""
+    M = ok.shape[0]
+    flat_ok = ok.reshape(M, -1)
+    flat_rep = replacer.reshape(M, -1)
+
+    sentinel = jnp.full((), 0xFFFFFFFFFFFFFFFF, U64)
+
+    def per_site(okr, repr_):
+        # sort invalid lanes (mapped to the sentinel) to the end, dedup
+        # consecutive equals, then scatter-compact the survivors.  A genuine
+        # replacer of ~0 is indistinguishable from the sentinel, but ~0 is a
+        # special int and already filtered by hint_matrix.
+        key = jnp.sort(jnp.where(okr, repr_, sentinel))
+        dup = jnp.concatenate([jnp.zeros((1,), bool), key[1:] == key[:-1]])
+        valid = (key != sentinel) & ~dup
+        pos = jnp.cumsum(valid) - 1
+        idx = jnp.where(valid & (pos < max_out), pos, max_out)  # oob -> drop
+        out = jnp.zeros((max_out,), U64).at[idx].set(key, mode="drop")
+        n = jnp.minimum(jnp.sum(valid), max_out)
+        return out, jnp.arange(max_out) < n
+
+    return jax.vmap(per_site)(flat_ok, flat_rep)

@@ -1,0 +1,143 @@
+"""Comparison-operand hints: turn observed kernel comparisons into mutants.
+
+Capability parity with reference /root/reference/prog/hints.go:33-207:
+the executor (KCOV_TRACE_CMP) reports every comparison `(op1, op2)` a call
+performed; `CompMap` records op->comparand sets; `mutate_with_hints`
+substitutes matched argument values with the comparands, modeling integer
+narrowing/widening casts via `shrink_expand`.
+
+The batched device counterpart (thousands of comp traces joined against
+a candidate batch at once) lives in ops/hints.py; this module is the exact
+host semantics it is parity-tested against.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Iterable, List, Set
+
+from .generation import SPECIAL_INTS
+from .prog import Call, ConstArg, DataArg, Prog, foreach_subarg
+from .types import Dir
+
+MAX_DATA_LENGTH = 100
+UINT64_MASK = (1 << 64) - 1
+
+_SPECIAL_SET = frozenset(v & UINT64_MASK for v in SPECIAL_INTS)
+
+
+class CompMap:
+    """operand -> set of values it was compared against."""
+
+    def __init__(self) -> None:
+        self.ops: Dict[int, Set[int]] = {}
+
+    def add(self, op1: int, op2: int) -> None:
+        self.ops.setdefault(op1 & UINT64_MASK, set()).add(op2 & UINT64_MASK)
+
+    def __len__(self) -> int:
+        return len(self.ops)
+
+    def comparands(self, v: int) -> Set[int]:
+        return self.ops.get(v & UINT64_MASK, set())
+
+    @classmethod
+    def from_pairs(cls, pairs: Iterable) -> "CompMap":
+        m = cls()
+        for a, b in pairs:
+            m.add(a, b)
+        return m
+
+
+def shrink_expand(v: int, comps: CompMap) -> Set[int]:
+    """All replacement values for an argument observed as `v`.
+
+    Models casts (reference hints.go:120-178): the kernel may compare a
+    narrowed (u8/u16/u32 truncation) or sign-extended version of the
+    argument, so each width variant of `v` is looked up in the comp map and
+    a match splices the comparand's low `size` bits back into `v`. Matches
+    whose comparand is wider than the cast are ignored, as are special
+    "interesting" ints the generator already tries.
+    """
+    v &= UINT64_MASK
+    variants: Dict[int, int] = {}  # candidate looked-up value -> cast width
+    for size in (8, 16, 32):
+        mask = (1 << size) - 1
+        variants[v & mask] = size
+        if v & (1 << (size - 1)):  # negative in this width: sign-extend
+            variants[(v | ~mask) & UINT64_MASK] = size
+    variants[v] = 64
+
+    out: Set[int] = set()
+    for cand, size in variants.items():
+        mask = (1 << size) - 1
+        for new_v in comps.comparands(cand):
+            hi = new_v & ~mask & UINT64_MASK
+            # comparand must fit the cast width (zero- or sign-extended)
+            if hi != 0 and hi != (~mask & UINT64_MASK):
+                continue
+            if (new_v & mask) in _SPECIAL_SET:
+                continue
+            out.add(((v & ~mask) | (new_v & mask)) & UINT64_MASK)
+    out.discard(v)
+    return out
+
+
+def _bytes_to_u64(data: bytes, i: int) -> int:
+    chunk = data[i:i + 8]
+    return int.from_bytes(chunk + b"\x00" * (8 - len(chunk)), "little")
+
+
+def mutate_with_hints(p: Prog, comp_maps: List[CompMap],
+                      exec_cb: Callable[[Prog], None]) -> int:
+    """For each (call, arg) match against that call's CompMap, build a
+    mutant program and hand it to `exec_cb` (reference MutateWithHints,
+    hints.go:50-60). Returns the number of mutants produced."""
+    count = 0
+    for ci, call in enumerate(p.calls):
+        if ci >= len(comp_maps):
+            break
+        comps = comp_maps[ci]
+        if not comps or call.meta is p.target.mmap_syscall:
+            continue
+        count += _hint_call(p, ci, comps, exec_cb)
+    return count
+
+
+def _arg_occurrences(call: Call) -> List:
+    """Args of a call in a stable traversal order (same order on a clone)."""
+    out: List = []
+    for a in call.args:
+        foreach_subarg(a, lambda arg, _parent: out.append(arg))
+    return out
+
+
+def _hint_call(p: Prog, ci: int, comps: CompMap,
+               exec_cb: Callable[[Prog], None]) -> int:
+    # Enumerate mutation sites on the original; apply each to a fresh clone,
+    # locating the arg by occurrence index (clone preserves structure).
+    sites: List = []  # (occurrence idx, kind, replacer, byte offset)
+    for idx, arg in enumerate(_arg_occurrences(p.calls[ci])):
+        if isinstance(arg, ConstArg):
+            for rep in sorted(shrink_expand(arg.val, comps)):
+                sites.append((idx, "const", rep, 0))
+        elif isinstance(arg, DataArg) and arg.typ.dir in (Dir.IN, Dir.INOUT):
+            data = bytes(arg.data)
+            for off in range(min(len(data), MAX_DATA_LENGTH)):
+                for rep in sorted(shrink_expand(_bytes_to_u64(data, off),
+                                                comps)):
+                    sites.append((idx, "data", rep, off))
+
+    for idx, kind, rep, off in sites:
+        clone = p.clone()
+        target_arg = _arg_occurrences(clone.calls[ci])[idx]
+        if kind == "const":
+            target_arg.val = rep
+        else:
+            data = bytearray(target_arg.data)
+            chunk = rep.to_bytes(8, "little")
+            n = min(8, len(data) - off)
+            data[off:off + n] = chunk[:n]
+            target_arg.data = bytes(data)
+        clone.validate()
+        exec_cb(clone)
+    return len(sites)

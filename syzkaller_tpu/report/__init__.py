@@ -1,0 +1,270 @@
+"""Crash-report recognition, title extraction, and dedup.
+
+Capability parity with reference /root/reference/pkg/report/report.go:20-465:
+a table of oops families (KASAN, BUG, WARNING, lockdep, rcu stalls, GPF,
+panics, kmemleak, ...) each with title-extraction patterns; `contains_crash`
+is the console-monitor hot predicate; `parse` finds the first crash, formats
+a canonical dedup title, and slices the report text out of the console
+stream. `Symbolizer` (report/symbolize.py) rewrites stack traces via
+addr2line.
+
+Pattern syntax: Python regexes with placeholder macros expanded before
+compilation — {{FUNC}} (captures the function name), {{PC}}, {{ADDR}},
+{{SRC}} (captures file:line). Title formats refer to capture groups as {0},
+{1}, ... in pattern-capture order.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+_MACROS = {
+    "{{FUNC}}": r"([a-zA-Z0-9_]+)(?:\.(?:constprop|isra|part)\.[0-9]+)?"
+                r"(?:\+0x[0-9a-f]+(?:/0x[0-9a-f]+)?)?",
+    "{{PC}}": r"(?:\[<)?(?:0x)?[0-9a-f]{8,16}(?:>\])?",
+    "{{ADDR}}": r"(?:0x)?[0-9a-f]{8,16}",
+    "{{SRC}}": r"([a-zA-Z0-9_\-./]+\.[chS]:[0-9]+)",
+}
+
+
+def _compile(pattern: str) -> re.Pattern:
+    for macro, repl in _MACROS.items():
+        pattern = pattern.replace(macro, repl)
+    return re.compile(pattern)
+
+
+@dataclass
+class _Format:
+    pattern: re.Pattern
+    title: str  # with {0}-style group refs
+
+
+@dataclass
+class Oops:
+    header: str
+    formats: List[_Format]
+    suppressions: List[re.Pattern] = field(default_factory=list)
+
+
+def _fmt(header: str, entries: Sequence[Tuple[str, str]],
+         suppressions: Sequence[str] = ()) -> Oops:
+    return Oops(header,
+                [_Format(_compile(p), t) for p, t in entries],
+                [_compile(s) for s in suppressions])
+
+
+# Family table. Order matters: first matching header wins; within a family
+# the first matching (usually most specific) format names the crash.
+OOPSES: List[Oops] = [
+    _fmt("BUG:", [
+        (r"BUG: KASAN: ([a-z\-]+) in {{FUNC}}(?:.*\n)+?.*(Read|Write) of size ([0-9]+)",
+         "KASAN: {0} {2} in {1}"),
+        (r"BUG: KASAN: ([a-z\-]+) on address(?:.*\n)+?.*(Read|Write) of size ([0-9]+)",
+         "KASAN: {0} {1} of size {2}"),
+        (r"BUG: KASAN: (.*)", "KASAN: {0}"),
+        (r"BUG: unable to handle kernel paging request(?:.*\n)+?.*IP: (?:{{PC}} +)?{{FUNC}}",
+         "BUG: unable to handle kernel paging request in {0}"),
+        (r"BUG: unable to handle kernel NULL pointer dereference(?:.*\n)+?.*IP: (?:{{PC}} +)?{{FUNC}}",
+         "BUG: unable to handle kernel NULL pointer dereference in {0}"),
+        (r"BUG: spinlock lockup suspected", "BUG: spinlock lockup suspected"),
+        (r"BUG: spinlock recursion", "BUG: spinlock recursion"),
+        (r"BUG: spinlock bad magic", "BUG: spinlock bad magic"),
+        (r"BUG: soft lockup", "BUG: soft lockup"),
+        (r"BUG: .*still has locks held!(?:.*\n)+?.*{{PC}} +{{FUNC}}",
+         "BUG: still has locks held in {0}"),
+        (r"BUG: bad unlock balance detected!(?:.*\n)+?.*{{PC}} +{{FUNC}}",
+         "BUG: bad unlock balance in {0}"),
+        (r"BUG: held lock freed!(?:.*\n)+?.*{{PC}} +{{FUNC}}",
+         "BUG: held lock freed in {0}"),
+        (r"BUG: Bad rss-counter state", "BUG: Bad rss-counter state"),
+        (r"BUG: non-zero nr_ptes on freeing mm",
+         "BUG: non-zero nr_ptes on freeing mm"),
+        (r"BUG: non-zero nr_pmds on freeing mm",
+         "BUG: non-zero nr_pmds on freeing mm"),
+        (r"BUG: Dentry .* still in use \([0-9]+\) \[unmount of ([^\]]+)\]",
+         "BUG: Dentry still in use [unmount of {0}]"),
+        (r"BUG: Bad page state", "BUG: Bad page state"),
+        (r"BUG: unable to handle kernel",
+         "BUG: unable to handle kernel"),
+        (r"BUG: (.*)", "BUG: {0}"),
+    ], suppressions=[r"BUG: using __this_cpu_"]),
+    _fmt("WARNING:", [
+        (r"WARNING: possible circular locking dependency detected(?:.*\n)+?"
+         r".*is trying to acquire lock(?:.*\n)+?.*at: (?:{{PC}} +)?{{FUNC}}",
+         "possible deadlock in {0}"),
+        (r"WARNING: possible irq lock inversion dependency detected(?:.*\n)+?"
+         r".*just changed the state of lock(?:.*\n)+?.*at: (?:{{PC}} +)?{{FUNC}}",
+         "possible deadlock in {0}"),
+        (r"WARNING: SOFTIRQ-safe -> SOFTIRQ-unsafe lock order detected"
+         r"(?:.*\n)+?.*is trying to acquire(?:.*\n)+?.*at: (?:{{PC}} +)?{{FUNC}}",
+         "possible deadlock in {0}"),
+        (r"WARNING: possible recursive locking detected(?:.*\n)+?"
+         r".*is trying to acquire lock(?:.*\n)+?.*at: (?:{{PC}} +)?{{FUNC}}",
+         "possible deadlock in {0}"),
+        (r"WARNING: inconsistent lock state(?:.*\n)+?.*takes(?:.*\n)+?"
+         r".*at: (?:{{PC}} +)?{{FUNC}}", "inconsistent lock state in {0}"),
+        (r"WARNING: suspicious RCU usage(?:.*\n)+?.*?{{SRC}}",
+         "suspicious RCU usage at {0}"),
+        (r"WARNING: kernel stack regs at [0-9a-f]+ in [^ ]* has bad "
+         r"'([^']+)' value", "WARNING: kernel stack regs has bad '{0}' value"),
+        (r"WARNING: kernel stack frame pointer at [0-9a-f]+ in [^ ]* has "
+         r"bad value", "WARNING: kernel stack frame pointer has bad value"),
+        (r"WARNING: .* at {{SRC}} {{FUNC}}", "WARNING in {1}"),
+        (r"WARNING: (.*)", "WARNING: {0}"),
+    ], suppressions=[r"WARNING: /etc/ssh/moduli does not exist"]),
+    _fmt("INFO:", [
+        (r"INFO: possible circular locking dependency detected(?:.*\n)+?"
+         r".*is trying to acquire lock(?:.*\n)+?.*at: (?:{{PC}} +)?{{FUNC}}",
+         "possible deadlock in {0}"),
+        (r"INFO: rcu_(?:preempt|sched|bh) (?:self-)?detected"
+         r"(?: expedited)? stalls?", "INFO: rcu detected stall"),
+        (r"INFO: task .* blocked for more than [0-9]+ seconds",
+         "INFO: task hung"),
+        (r"INFO: suspicious RCU usage(?:.*\n)+?.*?{{SRC}}",
+         "suspicious RCU usage at {0}"),
+        (r"INFO: (.*)", "INFO: {0}"),
+    ], suppressions=[
+        r"INFO: lockdep is turned off",
+        r"INFO: Stall ended before state dump start",
+        r"INFO: NMI handler .* took too long to run",
+    ]),
+    _fmt("Unable to handle kernel paging request", [
+        (r"Unable to handle kernel paging request(?:.*\n)+?.*PC is at {{FUNC}}",
+         "unable to handle kernel paging request in {0}"),
+        (r"Unable to handle kernel paging request",
+         "unable to handle kernel paging request"),
+    ]),
+    _fmt("general protection fault:", [
+        (r"general protection fault:(?:.*\n)+?.*RIP: [0-9]+:(?:{{PC}} +{{PC}} +)?{{FUNC}}",
+         "general protection fault in {0}"),
+        (r"general protection fault:", "general protection fault"),
+    ]),
+    _fmt("Kernel panic", [
+        (r"Kernel panic - not syncing: Attempted to kill init!",
+         "kernel panic: Attempted to kill init!"),
+        (r"Kernel panic - not syncing: Couldn't open N_TTY ldisc",
+         "kernel panic: Couldn't open N_TTY ldisc"),
+        (r"Kernel panic - not syncing: (.*)", "kernel panic: {0}"),
+    ]),
+    _fmt("kernel BUG", [
+        (r"kernel BUG at {{SRC}}", "kernel BUG at {0}"),
+        (r"kernel BUG (.*)", "kernel BUG {0}"),
+    ]),
+    _fmt("Kernel BUG", [
+        (r"Kernel BUG (.*)", "kernel BUG {0}"),
+    ]),
+    _fmt("BUG kmalloc-", [
+        (r"BUG kmalloc-.*: Object already free", "BUG: Object already free"),
+    ]),
+    _fmt("divide error:", [
+        (r"divide error: (?:.*\n)+?.*RIP: [0-9]+:(?:{{PC}} +{{PC}} +)?{{FUNC}}",
+         "divide error in {0}"),
+        (r"divide error:", "divide error"),
+    ]),
+    _fmt("invalid opcode:", [
+        (r"invalid opcode: (?:.*\n)+?.*RIP: [0-9]+:(?:{{PC}} +{{PC}} +)?{{FUNC}}",
+         "invalid opcode in {0}"),
+        (r"invalid opcode:", "invalid opcode"),
+    ]),
+    _fmt("unreferenced object", [
+        (r"unreferenced object {{ADDR}} \(size ([0-9]+)\):(?:.*\n)+?"
+         r".*backtrace:(?:.*\n)+?.*{{PC}}.*\n.*{{PC}}.*\n.*{{PC}} {{FUNC}}",
+         "memory leak in {1} (size {0})"),
+        (r"unreferenced object", "memory leak"),
+    ]),
+    _fmt("UBSAN:", [
+        (r"UBSAN: (.*)", "UBSAN: {0}"),
+    ]),
+    _fmt("unregister_netdevice: waiting for", [
+        (r"unregister_netdevice: waiting for (.*) to become free",
+         "unregister_netdevice: waiting for DEV to become free"),
+    ]),
+]
+
+# "no output" / lost-connection pseudo-crashes are produced by the VM
+# monitor, not by this parser (reference vm/vm.go:100-...).
+
+_CONSOLE_PREFIX = re.compile(
+    r"^(?:<[0-9]+>)?(?:\[[ 0-9.]+\]\s?)?")
+
+
+@dataclass
+class Report:
+    title: str
+    report: str          # the crash text slice
+    output: str          # full console output it was found in
+    start_pos: int
+    end_pos: int
+    corrupted: bool = False
+    oops_header: str = ""
+
+
+def _strip_line(line: str) -> str:
+    return _CONSOLE_PREFIX.sub("", line)
+
+
+def contains_crash(output: str,
+                   ignores: Sequence[str] = ()) -> bool:
+    """The console-monitor hot predicate (reference ContainsCrash)."""
+    ign = [re.compile(i) for i in ignores]
+    return _find(output, ign) is not None
+
+
+def _suppressed(oops: Oops, line: str,
+                ignores: Sequence[re.Pattern]) -> bool:
+    return (any(s.search(line) for s in oops.suppressions)
+            or any(i.search(line) for i in ignores))
+
+
+def _find(output: str, ignores: Sequence[re.Pattern]
+          ) -> Optional[Tuple[int, Oops, str]]:
+    pos = 0
+    for raw in output.splitlines(keepends=True):
+        line = _strip_line(raw.rstrip("\n"))
+        for oops in OOPSES:
+            if oops.header in line and not _suppressed(oops, line, ignores):
+                return pos, oops, line
+        pos += len(raw)
+    return None
+
+
+def parse(output: str, ignores: Sequence[str] = ()) -> Optional[Report]:
+    """Find the first crash in console output; extract canonical title and
+    the report slice (reference Parse, report.go:369-465)."""
+    ign = [re.compile(i) for i in ignores]
+    found = _find(output, ign)
+    if found is None:
+        return None
+    start, oops, _line = found
+    # report slice: from the oops line to the end (the reference trims at
+    # subsequent unrelated-context markers; we keep a bounded window)
+    end = min(len(output), start + (64 << 10))
+    body = "\n".join(_strip_line(ln)
+                     for ln in output[start:end].splitlines())
+    title = None
+    for f in oops.formats:
+        m = f.pattern.search(body)
+        if m:
+            title = f.title.format(*m.groups())
+            break
+    corrupted = title is None
+    if title is None:
+        title = _strip_line(body.splitlines()[0])[:120] if body else oops.header
+    return Report(title=title, report=body, output=output,
+                  start_pos=start, end_pos=end, corrupted=corrupted,
+                  oops_header=oops.header)
+
+
+def extract_guilty_file(report: str) -> Optional[str]:
+    """First source file in the stack trace that is not a generic helper
+    (reference pkg/report/guilty.go)."""
+    generic = re.compile(
+        r"^(?:mm/kasan/|mm/slab|mm/slub|kernel/locking/|lib/|"
+        r"arch/x86/(?:lib|mm)/|include/)")
+    for m in re.finditer(r"([a-z0-9_\-./]+\.[chS]):[0-9]+", report):
+        f = m.group(1)
+        if not generic.search(f):
+            return f
+    return None

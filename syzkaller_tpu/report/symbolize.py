@@ -1,0 +1,83 @@
+"""Stack-trace symbolization via addr2line (reference pkg/symbolizer +
+report.go:567-659 Symbolize: rewrite `func+0xOFF/0xSIZE` frames with
+file:line from vmlinux)."""
+
+from __future__ import annotations
+
+import re
+import subprocess
+from typing import Dict, List, Optional, Tuple
+
+_FRAME = re.compile(
+    r"(?P<pre>.*?\[<(?P<pc>[0-9a-f]+)>\]\s+)?"
+    r"(?P<func>[a-zA-Z0-9_]+)\+(?P<off>0x[0-9a-f]+)/(?P<size>0x[0-9a-f]+)")
+
+
+class Symbolizer:
+    """Batch addr2line over a vmlinux image. Symbol table comes from `nm`
+    once; each frame's PC = sym_addr + offset."""
+
+    def __init__(self, vmlinux: str, addr2line: str = "addr2line",
+                 nm: str = "nm"):
+        self.vmlinux = vmlinux
+        self.addr2line = addr2line
+        self.nm = nm
+        self._symbols: Optional[Dict[str, List[Tuple[int, int]]]] = None
+
+    def _load_symbols(self) -> Dict[str, List[Tuple[int, int]]]:
+        if self._symbols is not None:
+            return self._symbols
+        out = subprocess.run([self.nm, "-nS", self.vmlinux],
+                             capture_output=True, text=True, check=True)
+        syms: Dict[str, List[Tuple[int, int]]] = {}
+        for line in out.stdout.splitlines():
+            parts = line.split()
+            if len(parts) == 4 and parts[2].lower() in ("t", "w"):
+                addr, size, _typ, name = parts
+                syms.setdefault(name, []).append(
+                    (int(addr, 16), int(size, 16)))
+        self._symbols = syms
+        return syms
+
+    def _resolve(self, pcs: List[int]) -> List[str]:
+        proc = subprocess.run(
+            [self.addr2line, "-afi", "-e", self.vmlinux]
+            + [hex(pc) for pc in pcs],
+            capture_output=True, text=True, check=True)
+        locs: List[str] = []
+        cur: List[str] = []
+        for line in proc.stdout.splitlines():
+            if line.startswith("0x"):
+                if cur:
+                    locs.append(cur[-1])
+                cur = []
+            elif ":" in line:
+                cur.append(line.strip())
+        if cur:
+            locs.append(cur[-1])
+        return locs
+
+    def symbolize_report(self, report: str) -> str:
+        """Append file:line to every frame whose symbol resolves."""
+        syms = self._load_symbols()
+        frames = []
+        for m in _FRAME.finditer(report):
+            cands = syms.get(m.group("func"))
+            if not cands:
+                continue
+            off = int(m.group("off"), 16)
+            size = int(m.group("size"), 16)
+            for addr, ssize in cands:
+                if ssize == size and off < ssize:
+                    frames.append((m, addr + off))
+                    break
+        if not frames:
+            return report
+        locs = self._resolve([pc for _, pc in frames])
+        out = report
+        # substitute back-to-front so match positions stay valid
+        for (m, _pc), loc in reversed(list(zip(frames, locs))):
+            ins = f" {loc}"
+            if loc and loc not in ("??:0", "??:?"):
+                out = out[: m.end()] + ins + out[m.end():]
+        return out

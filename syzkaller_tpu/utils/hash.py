@@ -1,0 +1,30 @@
+"""Program identity hashing (reference /root/reference/pkg/hash/hash.go:
+SHA1-based Sig with string form used for corpus keys and crash dedup)."""
+
+from __future__ import annotations
+
+import hashlib
+
+
+class Sig:
+    __slots__ = ("digest",)
+
+    def __init__(self, digest: bytes):
+        self.digest = digest
+
+    def __str__(self) -> str:
+        return self.digest.hex()
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Sig) and self.digest == other.digest
+
+    def __hash__(self) -> int:
+        return hash(self.digest)
+
+
+def hash_bytes(data: bytes) -> Sig:
+    return Sig(hashlib.sha1(data).digest())
+
+
+def hash_str(data: bytes) -> str:
+    return hashlib.sha1(data).hexdigest()

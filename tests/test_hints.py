@@ -1,0 +1,152 @@
+"""Hints tests: table-driven CompMap -> expected mutants (the reference's
+prog/hints_test.go:1-338 strategy) plus host<->device parity."""
+
+import random
+
+import pytest
+
+from syzkaller_tpu.prog import get_target
+from syzkaller_tpu.prog.encoding import deserialize, serialize
+from syzkaller_tpu.prog.hints import (
+    CompMap,
+    mutate_with_hints,
+    shrink_expand,
+)
+
+
+@pytest.fixture(scope="module")
+def target():
+    return get_target("linux", "amd64")
+
+
+# ---- shrink_expand table (semantics from reference hints.go:120-178) ----
+
+def se(v, pairs):
+    return shrink_expand(v, CompMap.from_pairs(pairs))
+
+
+def test_trivial_match():
+    # direct 64-bit match: replace whole value
+    assert se(0xDEAD, [(0xDEAD, 0xCAFE)]) == {0xCAFE}
+
+
+def test_shrink_u8():
+    # f(u16 0x1234): kernel compares (u8)0x34 vs 0xab -> splice low byte
+    assert se(0x1234, [(0x34, 0xAB)]) == {0x12AB}
+
+
+def test_shrink_u16():
+    assert se(0xABCD1234, [(0x1234, 0x5678)]) == {0xABCD5678}
+
+
+def test_shrink_rejects_wide_comparand():
+    # comparand wider than the cast width: no valid code does this
+    assert se(0x1234, [(0x34, 0xDEADBEEF)]) == set()
+
+
+def test_expand_sign_extension():
+    # f(i8 -1): kernel compares 0xff..ff vs 0xff..fe -> splice to -2
+    v = 0xFF
+    comps = [(0xFFFFFFFFFFFFFFFF, 0xFFFFFFFFFFFFFFFE)]
+    assert 0xFE in se(v, comps)
+
+
+def test_sign_extended_comparand_accepted():
+    # comparand with all-ones high bits fits (it is the sign extension)
+    assert se(0x1234, [(0x34, 0xFFFFFFFFFFFFFFFE)]) == {0x12FE}
+
+
+def test_special_ints_excluded():
+    # 0 and 0xff are special ints the generator already tries unprompted
+    assert se(0x1234, [(0x1234, 0)]) == set()
+    assert se(0x1234, [(0x34, 0xFF)]) == set()
+
+
+def test_no_self_replacement():
+    assert se(0x1234, [(0x1234, 0x1234)]) == set()
+
+
+def test_multiple_comparands():
+    got = se(0x10, [(0x10, 0x21), (0x10, 0x33)])
+    assert got == {0x21, 0x33}
+
+
+# ---- mutate_with_hints over real programs ----
+
+def test_hint_mutants_const_arg(target):
+    p = deserialize(target, "alarm(0x1234)\n")
+    comps = [CompMap.from_pairs([(0x1234, 0x4444), (0x34, 0xAB)])]
+    mutants = []
+    n = mutate_with_hints(p, comps, lambda q: mutants.append(q))
+    assert n == len(mutants) == 2
+    vals = sorted(m.calls[0].args[0].val for m in mutants)
+    assert vals == [0x12AB, 0x4444]
+    # original untouched
+    assert p.calls[0].args[0].val == 0x1234
+    for m in mutants:
+        serialize(m)  # must remain serializable
+
+
+def test_hint_mutants_data_arg(target):
+    # write(fd, ptr[data "abcd"], len): data byte scan should splice
+    p = deserialize(
+        target, 'write(0xffffffffffffffff, &0:0:0="abcd1234", 0x4)\n')
+    arg = p.calls[0].args[1].res
+    assert arg.data == b"abcd1234"
+    # the u64 read at byte offset 2 of the buffer
+    base = int.from_bytes(b"cd1234", "little")  # zero-padded to 8
+    comps = [CompMap.from_pairs([(base, 0x6666)])]
+    mutants = []
+    mutate_with_hints(p, comps, lambda q: mutants.append(q))
+    assert len(mutants) == 1
+    new_data = mutants[0].calls[0].args[1].res.data
+    assert new_data != arg.data
+    assert new_data[:2] == b"ab"  # splice at offset 2 leaves prefix
+
+
+def test_mmap_calls_skipped(target):
+    p = deserialize(
+        target, "mmap(&vma 0:1, 0x1000, 0x3, 0x32, 0xffffffffffffffff, 0x0)\n")
+    comps = [CompMap.from_pairs([(0x1000, 0x2000)])]
+    n = mutate_with_hints(p, comps, lambda q: None)
+    assert n == 0
+
+
+# ---- host <-> device parity ----
+
+def test_device_parity_random():
+    jnp = pytest.importorskip("jax.numpy")
+    import numpy as np
+
+    from syzkaller_tpu.ops.hints import hint_matrix, unique_replacers
+    from syzkaller_tpu.prog.generation import SPECIAL_INTS
+
+    rng = random.Random(0)
+    M, N = 24, 64
+    vals = [rng.getrandbits(rng.choice([8, 16, 32, 64])) for _ in range(M)]
+    pairs = []
+    for _ in range(N):
+        if pairs and rng.random() < 0.5:
+            # derive ops from value casts so there are real matches
+            v = rng.choice(vals)
+            w = rng.choice([8, 16, 32, 64])
+            mask = (1 << w) - 1
+            op = v & mask
+            if v & (1 << (w - 1)) and rng.random() < 0.5:
+                op = (v | ~mask) & 0xFFFFFFFFFFFFFFFF
+            pairs.append((op, rng.getrandbits(rng.choice([8, 16, 64]))))
+        else:
+            pairs.append((rng.getrandbits(64), rng.getrandbits(64)))
+
+    comps = CompMap.from_pairs(pairs)
+    expected = [shrink_expand(v, comps) for v in vals]
+
+    ok, rep = hint_matrix(
+        np.array(vals, np.uint64),
+        np.array([a for a, _ in pairs], np.uint64),
+        np.array([b for _, b in pairs], np.uint64),
+        np.array([v & 0xFFFFFFFFFFFFFFFF for v in SPECIAL_INTS], np.uint64))
+    out, mask = unique_replacers(ok, rep, max_out=64)
+    for i in range(M):
+        got = set(int(x) for x, m in zip(out[i], mask[i]) if m)
+        assert got == expected[i], (i, hex(vals[i]))

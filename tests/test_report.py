@@ -1,0 +1,144 @@
+"""Crash parsing tests: realistic console outputs -> expected titles
+(the reference's largest test surface, pkg/report/report_test.go)."""
+
+from syzkaller_tpu.report import contains_crash, extract_guilty_file, parse
+
+KASAN_UAF = """\
+[   44.226361] ==================================================================
+[   44.226845] BUG: KASAN: use-after-free in ip6_send_skb+0x2f5/0x330
+[   44.227340] Read of size 8 at addr ffff8800398d4780 by task syz-executor/4447
+[   44.227904]
+[   44.228041] CPU: 0 PID: 4447 Comm: syz-executor Not tainted 4.11.0 #1
+[   44.228588] Call Trace:
+[   44.228816]  dump_stack+0x115/0x1cf
+[   44.229129]  kasan_report+0x171/0x1b0
+[   44.229453]  ip6_send_skb+0x2f5/0x330 net/ipv6/ip6_output.c:1720
+[   44.229918]  udpv6_sendmsg+0x1dcb/0x2400
+"""
+
+GPF = """\
+[   30.111] general protection fault: 0000 [#1] SMP KASAN
+[   30.112] Modules linked in:
+[   30.113] CPU: 1 PID: 1325 Comm: syz-executor Not tainted 4.11.0 #1
+[   30.114] task: ffff880038e72f00 task.stack: ffff88003b6a0000
+[   30.115] RIP: 0010:sock_sendmsg+0xb2/0x110
+[   30.116] RSP: 0018:ffff88003b6a7b58 EFLAGS: 00010206
+"""
+
+TASK_HUNG = """\
+[  246.6] INFO: task syz-executor:5068 blocked for more than 120 seconds.
+[  246.7]       Not tainted 4.11.0 #1
+[  246.8] "echo 0 > /proc/sys/kernel/hung_task_timeout_secs" disables this message.
+"""
+
+PANIC = """\
+[   10.0] Kernel panic - not syncing: Fatal exception in interrupt
+[   10.1] Kernel Offset: disabled
+"""
+
+WARNING_AT = """\
+[   12.3] WARNING: CPU: 0 PID: 3654 at kernel/events/core.c:10336 perf_event_open+0x2d0/0x1bc0
+[   12.4] Kernel panic - not syncing: panic_on_warn set ...
+"""
+
+DEADLOCK = """\
+[   87.0] ======================================================
+[   87.1] WARNING: possible circular locking dependency detected
+[   87.2] 4.11.0 #1 Not tainted
+[   87.3] ------------------------------------------------------
+[   87.4] syz-executor/5068 is trying to acquire lock:
+[   87.5]  (&pipe->mutex/1){+.+.+.}, at: [<ffffffff8190c049>] pipe_lock+0x59/0x70
+"""
+
+KMEMLEAK = """\
+unreferenced object 0xffff88003b7cd580 (size 64):
+  comm "syz-executor", pid 4821, jiffies 4294945155 (age 13.690s)
+  hex dump (first 32 bytes):
+    00 00 00 00 00 00 00 00 00 00 00 00 00 00 00 00  ................
+  backtrace:
+    [<ffffffff8152b458>] kmemleak_alloc+0x28/0x50
+    [<ffffffff814f5163>] kmem_cache_alloc_trace+0x113/0x2f0
+    [<ffffffff8182d0d2>] sock_alloc_inode+0x52/0x120
+"""
+
+KERNEL_BUG_AT = """\
+[   55.1] kernel BUG at net/packet/af_packet.c:3651!
+[   55.2] invalid opcode: 0000 [#1] SMP KASAN
+"""
+
+
+def test_kasan_title():
+    r = parse(KASAN_UAF)
+    assert r is not None
+    assert r.title == "KASAN: use-after-free Read in ip6_send_skb"
+    assert not r.corrupted
+
+
+def test_gpf_title():
+    r = parse(GPF)
+    assert r.title == "general protection fault in sock_sendmsg"
+
+
+def test_task_hung():
+    assert parse(TASK_HUNG).title == "INFO: task hung"
+
+
+def test_panic():
+    assert parse(PANIC).title == \
+        "kernel panic: Fatal exception in interrupt"
+
+
+def test_warning_at():
+    assert parse(WARNING_AT).title == "WARNING in perf_event_open"
+
+
+def test_deadlock():
+    assert parse(DEADLOCK).title == "possible deadlock in pipe_lock"
+
+
+def test_kmemleak():
+    r = parse(KMEMLEAK)
+    assert r.title == "memory leak in sock_alloc_inode (size 64)"
+
+
+def test_kernel_bug_at():
+    assert parse(KERNEL_BUG_AT).title == \
+        "kernel BUG at net/packet/af_packet.c:3651"
+
+
+def test_no_crash():
+    out = "[  1.0] systemd[1]: Started Session 1 of user root.\n" * 50
+    assert parse(out) is None
+    assert not contains_crash(out)
+
+
+def test_contains_crash_hot_predicate():
+    assert contains_crash(KASAN_UAF)
+    assert contains_crash("x\n" * 1000 + GPF)
+
+
+def test_suppressions():
+    out = "[  1.0] WARNING: /etc/ssh/moduli does not exist, using fixed modulus\n"
+    assert not contains_crash(out)
+
+
+def test_custom_ignores():
+    assert contains_crash(TASK_HUNG)
+    assert not contains_crash(TASK_HUNG, ignores=[r"INFO: task .* blocked"])
+
+
+def test_first_crash_wins():
+    r = parse(TASK_HUNG + KASAN_UAF)
+    assert r.title == "INFO: task hung"
+
+
+def test_title_deduplicates():
+    # same crash from two runs with different addresses/pids -> same title
+    variant = KASAN_UAF.replace("4447", "9999").replace(
+        "ffff8800398d4780", "ffff88003b7cd580")
+    assert parse(KASAN_UAF).title == parse(variant).title
+
+
+def test_guilty_file():
+    r = parse(KASAN_UAF)
+    assert extract_guilty_file(r.report) == "net/ipv6/ip6_output.c"
