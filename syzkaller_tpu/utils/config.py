@@ -1,0 +1,46 @@
+"""Strict JSON config loading (reference /root/reference/pkg/config/
+config.go: unknown fields are rejected so typos fail loudly)."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import typing
+from typing import Any, Dict, Type, TypeVar
+
+T = TypeVar("T")
+
+
+class ConfigError(ValueError):
+    pass
+
+
+def load_dict(cls: Type[T], data: Dict[str, Any]) -> T:
+    """Instantiate dataclass `cls` from `data`, recursing into dataclass
+    fields, rejecting unknown keys."""
+    if not dataclasses.is_dataclass(cls):
+        raise ConfigError(f"{cls} is not a dataclass")
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    unknown = set(data) - set(fields)
+    if unknown:
+        raise ConfigError(f"unknown config fields: {sorted(unknown)} "
+                          f"(known: {sorted(fields)})")
+    hints = typing.get_type_hints(cls)  # resolves string annotations
+    kwargs = {}
+    for name, value in data.items():
+        ftype = hints.get(name)
+        if (isinstance(ftype, type) and dataclasses.is_dataclass(ftype)
+                and isinstance(value, dict)):
+            kwargs[name] = load_dict(ftype, value)
+        else:
+            kwargs[name] = value
+    return cls(**kwargs)
+
+
+def load_file(cls: Type[T], path: str) -> T:
+    with open(path) as f:
+        try:
+            data = json.load(f)
+        except json.JSONDecodeError as e:
+            raise ConfigError(f"{path}: {e}") from e
+    return load_dict(cls, data)
