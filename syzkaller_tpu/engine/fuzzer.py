@@ -48,6 +48,7 @@ class FuzzerConfig:
     triage_reruns: int = 3              # reference fuzzer.go:540
     fault_injection: bool = False
     collect_comps: bool = False
+    log_programs: bool = False          # emit `executing program` records
     sandbox: str = "none"
     device_period: int = 16             # consume a device batch every N steps
     env_config: Optional[EnvConfig] = None
@@ -187,6 +188,13 @@ class Fuzzer:
         re-enqueue triage work for the program's other calls."""
         opts = opts or ExecOpts()
         env = self.envs[pid % len(self.envs)]
+        if self.cfg.log_programs:
+            from ..utils.log import logf
+            if opts.fault_call >= 0:
+                logf(0, "executing program %d (fault-call:%d fault-nth:%d):\n%s",
+                     pid, opts.fault_call, opts.fault_nth, serialize(p))
+            else:
+                logf(0, "executing program %d:\n%s", pid, serialize(p))
         _, infos, failed, hanged = env.exec(opts, p)
         self.stats["exec_total"] += 1
         self.stats[stat] = self.stats.get(stat, 0) + 1

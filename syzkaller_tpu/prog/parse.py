@@ -1,0 +1,80 @@
+"""Extract executed programs from fuzzer console logs.
+
+Capability parity with reference /root/reference/prog/parse.go:22-71
+(Target.ParseLog): scan for `executing program N:` markers (optionally
+carrying fault-injection parameters), then deserialize the program text
+that follows each marker. Used by the repro pipeline to recover the
+programs that ran right before a crash.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import List
+
+from .encoding import deserialize
+from .prog import Prog
+
+_EXECUTING = re.compile(
+    r"executing program (\d+)"
+    r"(?: \(fault-call:(-?\d+) fault-nth:(\d+)\))?:")
+
+
+@dataclass
+class LogEntry:
+    p: Prog
+    proc: int = 0
+    start: int = 0  # character offset of the entry in the log
+    end: int = 0
+    fault: bool = False
+    fault_call: int = -1
+    fault_nth: int = 0
+
+
+def parse_log(target, data: str) -> List[LogEntry]:
+    entries: List[LogEntry] = []
+    lines = data.splitlines(keepends=True)
+    pos = 0
+    i = 0
+    while i < len(lines):
+        line = lines[i]
+        m = _EXECUTING.search(line)
+        start = pos
+        pos += len(line)
+        i += 1
+        if not m:
+            continue
+        # Collect candidate program lines until a blank line or the next
+        # marker; tolerate trailing junk by trying progressively shorter
+        # prefixes (the reference deserializes the whole chunk and drops
+        # unparsable entries; crashes truncate logs mid-line).
+        chunk: List[str] = []
+        chunk_end = pos
+        while i < len(lines):
+            nxt = lines[i]
+            if not nxt.strip() or _EXECUTING.search(nxt):
+                break
+            chunk.append(nxt)
+            chunk_end += len(nxt)
+            pos += len(nxt)
+            i += 1
+        p = _try_parse(target, chunk)
+        if p is None or not p.calls:
+            continue
+        ent = LogEntry(p=p, proc=int(m.group(1)), start=start, end=chunk_end)
+        if m.group(2) is not None:
+            ent.fault = True
+            ent.fault_call = int(m.group(2))
+            ent.fault_nth = int(m.group(3))
+        entries.append(ent)
+    return entries
+
+
+def _try_parse(target, chunk: List[str]) -> Prog | None:
+    for end in range(len(chunk), 0, -1):
+        try:
+            return deserialize(target, "".join(chunk[:end]))
+        except Exception:
+            continue
+    return None

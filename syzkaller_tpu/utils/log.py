@@ -1,0 +1,59 @@
+"""Leveled logging with an in-memory ring cache for crash-time dumps.
+
+Capability parity with reference /root/reference/pkg/log (Logf levels,
+EnableLogCaching, CachedLogOutput): when caching is enabled the last N
+lines are retained so a crash bundle can include recent fuzzer activity —
+in particular the `executing program` records that pkg/repro parses.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import deque
+from typing import Deque, Optional
+
+_lock = threading.Lock()
+_level = 0
+_cache: Optional[Deque[str]] = None
+_cache_max_mem = 0
+_stream = sys.stderr
+
+
+def set_verbosity(level: int) -> None:
+    global _level
+    _level = level
+
+
+def enable_log_caching(max_lines: int = 100000,
+                       max_mem: int = 8 << 20) -> None:
+    global _cache, _cache_max_mem
+    with _lock:
+        _cache = deque(maxlen=max_lines)
+        _cache_max_mem = max_mem
+
+
+def cached_log_output() -> str:
+    with _lock:
+        if _cache is None:
+            return ""
+        out, total = [], 0
+        for line in reversed(_cache):
+            total += len(line)
+            if _cache_max_mem and total > _cache_max_mem:
+                break
+            out.append(line)
+        return "".join(reversed(out))
+
+
+def logf(level: int, fmt: str, *args) -> None:
+    msg = (fmt % args) if args else fmt
+    line = "%s [%d] %s\n" % (
+        time.strftime("%Y/%m/%d %H:%M:%S"), level, msg)
+    with _lock:
+        if _cache is not None:
+            _cache.append(line)
+    if level <= _level:
+        _stream.write(line)
+        _stream.flush()
