@@ -74,9 +74,16 @@ class Manager:
         os.makedirs(self.crashdir, exist_ok=True)
 
         self._lock = threading.Lock()
+        # separate lock for corpus.db: DB has no internal locking and RPC
+        # handlers run on per-connection threads; also keeps file IO out
+        # of the main-lock critical sections
+        self._db_lock = threading.Lock()
         self.phase = PHASE_INIT
         self.start_time = time.time()
-        self.stats: Dict[str, int] = {}
+        self.stats: Dict[str, int] = {}  # the manager's own counters
+        # absolute per-fuzzer counter snapshots (summed for reporting);
+        # a single shared dict would flip-flop between fuzzers' values
+        self._fuzzer_stats: Dict[str, Dict[str, int]] = {}
         self.connected_fuzzers: Set[str] = set()
         self.crashes: Dict[str, CrashEntry] = {}
         self.max_signal: Set[int] = set()
@@ -139,8 +146,9 @@ class Manager:
             self.corpus[h] = text
             self.corpus_signal[h] = sorted(signal)
             self._note_signal(signal)
-        self.db.save(h.encode(), text.encode())
-        self.db.flush()
+        with self._db_lock:
+            self.db.save(h.encode(), text.encode())
+            self.db.flush()
         return True
 
     def _note_signal(self, signal: Sequence[int]) -> None:
@@ -165,10 +173,11 @@ class Manager:
             for h in drop:
                 del self.corpus[h]
                 del self.corpus_signal[h]
-        for h in drop:
-            self.db.delete(h.encode())
-        if drop:
-            self.db.flush()
+        with self._db_lock:
+            for h in drop:
+                self.db.delete(h.encode())
+            if drop:
+                self.db.flush()
         return len(drop)
 
     # ---- RPC methods (called by _RpcHandler) ----
@@ -185,13 +194,14 @@ class Manager:
             if not self.candidates and nc and \
                     self.phase == PHASE_LOADED_CORPUS:
                 self.phase = PHASE_TRIAGED_CORPUS
+            max_signal = sorted(self.max_signal)
         prios = calculate_priorities(
             self.target, [deserialize(self.target, t) for t in
                           list(corpus)[:256]])
         return {
             "corpus": corpus,
             "prios": prios.tolist(),
-            "max_signal": sorted(self.max_signal),
+            "max_signal": max_signal,
             "candidates": take,
             "enabled": None,
         }
@@ -210,8 +220,9 @@ class Manager:
     def on_poll(self, name: str, stats: Dict[str, int],
                 need_candidates: bool, new_signal: Sequence[int]):
         with self._lock:
-            for k, v in (stats or {}).items():
-                self.stats[k] = int(v)  # absolute counters per fuzzer
+            if stats:
+                self._fuzzer_stats[name] = {
+                    k: int(v) for k, v in stats.items()}
             self._note_signal(new_signal)
             cur = self._signal_cursor.get(name, 0)
             delta = self._signal_log[cur:]
@@ -220,8 +231,12 @@ class Manager:
             self._pending[name] = []
             cands = []
             if need_candidates or self.candidates:
+                had = bool(self.candidates)
                 cands = self.candidates[:100]
                 self.candidates = self.candidates[100:]
+                if had and not self.candidates and \
+                        self.phase == PHASE_LOADED_CORPUS:
+                    self.phase = PHASE_TRIAGED_CORPUS
         return {
             "new_inputs": inputs,
             "candidates": cands,
@@ -261,6 +276,10 @@ class Manager:
 
     def snapshot(self) -> Dict[str, object]:
         with self._lock:
+            fleet: Dict[str, int] = {}
+            for per in self._fuzzer_stats.values():
+                for k, v in per.items():
+                    fleet[k] = fleet.get(k, 0) + v
             return {
                 "uptime_s": round(time.time() - self.start_time, 1),
                 "phase": self.phase,
@@ -270,6 +289,7 @@ class Manager:
                 "fuzzers": len(self.connected_fuzzers),
                 "crashes": sum(e.count for e in self.crashes.values()),
                 "crash_types": len(self.crashes),
+                **fleet,
                 **self.stats,
             }
 

@@ -100,13 +100,13 @@ class OutputMerger:
     vm/vmimpl/merger.go)."""
 
     def __init__(self) -> None:
-        self._buf: List[bytes] = []
+        self._buf = bytearray()
         self._cond = threading.Condition()
         self._eof = False
 
     def feed(self, chunk: bytes) -> None:
         with self._cond:
-            self._buf.append(chunk)
+            self._buf.extend(chunk)
             self._cond.notify_all()
 
     def finish(self) -> None:
@@ -114,14 +114,18 @@ class OutputMerger:
             self._eof = True
             self._cond.notify_all()
 
-    def attach(self, stream) -> threading.Thread:
+    def attach(self, stream, finish: bool = True) -> threading.Thread:
+        """Pump a stream into the merger. finish=False for transient
+        command streams sharing a long-lived console merger — their EOF
+        must not mark the merger (and thus the instance) dead."""
         def pump():
             try:
                 for line in iter(stream.readline, b""):
                     self.feed(line)
             except (OSError, ValueError):
                 pass
-            self.finish()
+            if finish:
+                self.finish()
 
         t = threading.Thread(target=pump, daemon=True)
         t.start()
@@ -132,16 +136,20 @@ class OutputMerger:
         deadline = time.time() + timeout
         with self._cond:
             while True:
-                if sum(map(len, self._buf)) > have or self._eof:
+                if len(self._buf) > have or self._eof:
                     return True
                 left = deadline - time.time()
                 if left <= 0:
                     return False
                 self._cond.wait(left)
 
-    def output(self) -> bytes:
+    def size(self) -> int:
         with self._cond:
-            return b"".join(self._buf)
+            return len(self._buf)
+
+    def output(self, start: int = 0) -> bytes:
+        with self._cond:
+            return bytes(self._buf[start:])
 
     @property
     def eof(self) -> bool:
@@ -169,31 +177,41 @@ def monitor_execution(merger: OutputMerger, proc,
     'lost connection' pseudo-crashes)."""
     ignores = ignores or []
     deadline = time.time() + timeout
-    last_len = 0
+    start_len = merger.size()  # only this command's output matters
+    last_len = start_len
     last_output_time = time.time()
+    # Incremental scan: only new output (plus one line of overlap for
+    # chunks split mid-line) is regex-scanned each wake; the full text is
+    # re-parsed once, only when a crash is actually detected.
+    overlap = 1 << 12
     while True:
         if stop is not None and stop.is_set():
-            return MonitorResult(None, merger.output())
+            return MonitorResult(None, merger.output(start_len))
         merger.wait(last_len, timeout=5.0)
-        out = merger.output()
-        if len(out) > last_len:
-            last_len = len(out)
+        size = merger.size()
+        if size > last_len:
+            window_start = max(start_len, last_len - overlap)
+            window = merger.output(window_start).decode("utf-8", "replace")
+            last_len = size
             last_output_time = time.time()
-            text = out.decode("utf-8", "replace")
-            rep = parse_report(text, ignores=ignores)
-            if rep is not None:
+            if parse_report(window, ignores=ignores) is not None:
                 time.sleep(1.0)  # let the rest of the report stream in
-                text = merger.output().decode("utf-8", "replace")
+                text = merger.output(start_len).decode("utf-8", "replace")
                 return MonitorResult(parse_report(text, ignores=ignores),
-                                     merger.output())
-        if merger.eof:
+                                     merger.output(start_len))
+        cmd_exited = proc is not None and proc.poll() is not None
+        if merger.eof or cmd_exited:
+            time.sleep(0.2)  # let the pump thread drain trailing output
             rc = proc.poll() if proc is not None else 0
             lost = rc not in (0, None)
-            return MonitorResult(None, out, lost_connection=lost)
+            return MonitorResult(None, merger.output(start_len),
+                                 lost_connection=lost)
         if time.time() > deadline:
-            return MonitorResult(None, out, timed_out=True)
+            return MonitorResult(None, merger.output(start_len),
+                                 timed_out=True)
         if time.time() - last_output_time > no_output_timeout:
-            return MonitorResult(None, out, no_output=True)
+            return MonitorResult(None, merger.output(start_len),
+                                 no_output=True)
 
 
 # ---------------------------------------------------------------------- #
@@ -297,7 +315,13 @@ class QemuInstance(Instance):
             args, cwd=self.dir, stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT, start_new_session=True)
         self.merger.attach(self.proc.stdout)
-        self._wait_ssh()
+        try:
+            self._wait_ssh()
+        except BaseException:
+            # never leak a booted-but-unreachable qemu (or its tmpdir):
+            # the caller has no Instance handle to close() yet
+            self.close()
+            raise
 
     def _ssh_base(self) -> List[str]:
         key = ["-i", self.cfg.sshkey] if self.cfg.sshkey else []
@@ -345,7 +369,9 @@ class QemuInstance(Instance):
             self._ssh_base() + fwd + [command],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             start_new_session=True)
-        self.merger.attach(proc.stdout)
+        # finish=False: the ssh command's EOF must not mark the shared
+        # console merger dead — the instance outlives individual commands
+        self.merger.attach(proc.stdout, finish=False)
         return self.merger, proc
 
     def close(self) -> None:
@@ -355,4 +381,5 @@ class QemuInstance(Instance):
             except (ProcessLookupError, PermissionError):
                 pass
             self.proc.wait()
+        shutil.rmtree(self.dir, ignore_errors=True)
         shutil.rmtree(self.dir, ignore_errors=True)
