@@ -193,10 +193,10 @@ _CONSOLE_PREFIX = re.compile(
 @dataclass
 class Report:
     title: str
-    report: str          # the crash text slice
-    output: str          # full console output it was found in
-    start_pos: int
-    end_pos: int
+    report: str = ""     # the crash text slice
+    output: str = ""     # full console output it was found in
+    start_pos: int = 0
+    end_pos: int = 0
     corrupted: bool = False
     oops_header: str = ""
 

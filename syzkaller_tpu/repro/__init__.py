@@ -1,0 +1,336 @@
+"""Automated crash reproduction.
+
+Capability parity with reference /root/reference/pkg/repro/repro.go:63-552:
+given a crash log, recover the programs executed before the crash
+(prog/parse), find the crashing subset by bisection over trailing
+programs, minimize the program with a crash predicate (prog.minimize),
+simplify execution options, then extract a standalone C reproducer and
+simplify its option matrix.
+
+The reference tests hypotheses by rebooting VMs and running syz-execprog
+inside them; here the testing surface is the `Tester` interface so the
+pipeline itself is hermetic — `VMTester` provides the real
+boot-VM/run-execprog/watch-console path, and tests inject a predicate.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+import sys
+import tempfile
+import time
+from dataclasses import dataclass, field, replace
+from typing import Callable, List, Optional, Sequence
+
+from .. import csource
+from ..ipc import ExecOpts
+from ..prog.encoding import serialize
+from ..prog.mutation import minimize
+from ..prog.parse import parse_log
+from ..prog.prog import Prog
+from ..report import Report
+from ..utils.log import logf
+
+# How many trailing log programs bisection starts from (the crash cause is
+# almost always recent; reference repro.go caps similarly).
+MAX_BISECT_PROGS = 20
+
+
+@dataclass
+class Stats:
+    extract_time: float = 0.0
+    minimize_time: float = 0.0
+    simplify_prog_time: float = 0.0
+    extract_c_time: float = 0.0
+    simplify_c_time: float = 0.0
+    exec_runs: int = 0
+
+
+@dataclass
+class Result:
+    # the single-program reproducer, or None when only the multi-program
+    # sequence in `progs` reproduces the crash
+    prog: Optional[Prog]
+    opts: ExecOpts
+    progs: List[Prog] = field(default_factory=list)  # what actually crashed
+    c_src: Optional[str] = None  # C reproducer source, if extraction worked
+    c_opts: Optional[csource.Options] = None
+    duration: float = 0.0
+    stats: Stats = field(default_factory=Stats)
+    title: str = ""
+
+
+class Tester:
+    """Crash-hypothesis testing surface: run programs with options, report
+    whether the target crashed (and with what title)."""
+
+    def test_progs(self, progs: Sequence[Prog], opts: ExecOpts,
+                   duration: float) -> Optional[Report]:
+        raise NotImplementedError
+
+    def test_c_bin(self, bin_path: str, duration: float) -> Optional[Report]:
+        raise NotImplementedError
+
+
+class VMTester(Tester):
+    """Boots instances from a vm.Pool, replays programs via the execprog
+    tool, and watches the console for oops output (the reference's
+    testProgs path, repro.go:506-552)."""
+
+    def __init__(self, pool, instance_indexes: Sequence[int] = (0,),
+                 ignores: Optional[List[str]] = None,
+                 python: str = sys.executable):
+        self.pool = pool
+        self.indexes = list(instance_indexes)
+        self.ignores = ignores or []
+        self.python = python
+
+    def test_progs(self, progs, opts, duration):
+        from ..vm import monitor_execution
+
+        inst = self.pool.create(self.indexes[0])
+        try:
+            fd, path = tempfile.mkstemp(suffix=".prog")
+            with os.fdopen(fd, "w") as f:
+                f.write("\n\n".join(serialize(p).strip() for p in progs)
+                        + "\n")
+            guest = inst.copy(path)
+            os.unlink(path)
+            flags = ""
+            if opts.threaded:
+                flags += " -threaded"
+            if opts.collide:
+                flags += " -collide"
+            if opts.fault_call >= 0:
+                flags += (f" -fault-call {opts.fault_call}"
+                          f" -fault-nth {opts.fault_nth}")
+            cmd = (f"{shlex.quote(self.python)} -m "
+                   f"syzkaller_tpu.tools.execprog -repeat 0{flags} "
+                   f"{shlex.quote(guest)}")
+            merger, proc = inst.run(cmd, timeout=duration)
+            res = monitor_execution(merger, proc, timeout=duration,
+                                    no_output_timeout=duration,
+                                    ignores=self.ignores)
+            return res.report
+        finally:
+            inst.close()
+
+    def test_c_bin(self, bin_path, duration):
+        from ..vm import monitor_execution
+
+        inst = self.pool.create(self.indexes[0])
+        try:
+            guest = inst.copy(bin_path)
+            merger, proc = inst.run(guest, timeout=duration)
+            res = monitor_execution(merger, proc, timeout=duration,
+                                    no_output_timeout=duration,
+                                    ignores=self.ignores)
+            return res.report
+        finally:
+            inst.close()
+
+
+def run(crash_log: str, target, tester: Tester,
+        test_duration: float = 30.0) -> Optional[Result]:
+    """The full pipeline. Returns None when the crash does not reproduce
+    from the logged programs."""
+    t_start = time.time()
+    stats = Stats()
+    entries = parse_log(target, crash_log)
+    if not entries:
+        logf(1, "repro: no programs parsed from the crash log")
+        return None
+    logf(1, "repro: %d programs in log", len(entries))
+
+    def crashed(progs: Sequence[Prog], opts: ExecOpts) -> Optional[Report]:
+        stats.exec_runs += 1
+        return tester.test_progs(progs, opts, test_duration)
+
+    # default exec opts mirror the fuzzer's (threaded repro first, like
+    # the reference, which simplifies away later)
+    opts = ExecOpts(threaded=True, collide=True)
+    for e in entries:
+        if e.fault:
+            opts.fault_call = e.fault_call
+            opts.fault_nth = e.fault_nth
+            break
+
+    # --- phase 1: which program(s) crash? ---
+    t0 = time.time()
+    progs, rep = _extract(entries, opts, crashed)
+    stats.extract_time = time.time() - t0
+    if not progs:
+        logf(1, "repro: crash did not reproduce from logged programs")
+        return None
+    title = rep.title if rep else ""
+
+    # Multi-program reproducers are folded into one program by
+    # concatenation when possible (the common case after bisection is a
+    # single program anyway).
+    p = progs[-1] if len(progs) == 1 else _concat(target, progs)
+    check = _single_pred(crashed, opts)
+    if len(progs) > 1 and not check(p):
+        # concatenation broke it: fall back to the last program alone,
+        # else give up on a single-program reproducer and return the
+        # crashing sequence itself (progs), unminimized
+        if check(progs[-1]):
+            p = progs[-1]
+        else:
+            return Result(prog=None, progs=progs, opts=opts, title=title,
+                          stats=stats, duration=time.time() - t_start)
+
+    # --- phase 2: minimize the program ---
+    t0 = time.time()
+    p, _ = minimize(p, -1, lambda q, _ci: check(q), crash=True)
+    stats.minimize_time = time.time() - t0
+
+    # --- phase 3: simplify exec options ---
+    t0 = time.time()
+    for simplify in _PROG_SIMPLIFIES:
+        cand = simplify(opts)
+        if cand is None:
+            continue
+        if crashed([p], cand):
+            opts = cand
+    stats.simplify_prog_time = time.time() - t0
+
+    result = Result(prog=p, progs=[p], opts=opts, title=title, stats=stats)
+
+    # --- phase 4: C reproducer ---
+    t0 = time.time()
+    copts = csource.Options(
+        threaded=opts.threaded, collide=opts.collide, repeat=True,
+        fault=opts.fault_call >= 0, fault_call=opts.fault_call,
+        fault_nth=opts.fault_nth, sandbox="none")
+    src = _test_c(p, copts, tester, test_duration, stats)
+    stats.extract_c_time = time.time() - t0
+    if src is not None:
+        # --- phase 5: simplify C options ---
+        t0 = time.time()
+        for simplify in _C_SIMPLIFIES:
+            cand = simplify(copts)
+            if cand is None:
+                continue
+            src2 = _test_c(p, cand, tester, test_duration, stats)
+            if src2 is not None:
+                copts, src = cand, src2
+        stats.simplify_c_time = time.time() - t0
+        result.c_src = src
+        result.c_opts = copts
+
+    result.duration = time.time() - t_start
+    return result
+
+
+def _single_pred(crashed, opts) -> Callable[[Prog], bool]:
+    return lambda p: crashed([p], opts) is not None
+
+
+def _extract(entries, opts, crashed):
+    """extractProgSingle then extractProgBisect (repro.go:290-400):
+    last program alone, then delta-debug the trailing window."""
+    last = entries[-1].p
+    rep = crashed([last], opts)
+    if rep is not None:
+        return [last], rep
+    progs = [e.p for e in entries[-MAX_BISECT_PROGS:]]
+    if len(progs) > 1:
+        rep = crashed(progs, opts)
+        if rep is None:
+            return [], None
+        progs, rep = _ddmin(progs, opts, crashed, rep)
+        return progs, rep
+    return [], None
+
+
+def _ddmin(progs, opts, crashed, rep):
+    """Greedy delta-debugging over the program list: try dropping halves,
+    then quarters, ... until 1-minimal."""
+    n = 2
+    while len(progs) >= 2:
+        chunk = max(1, len(progs) // n)
+        shrunk = False
+        i = 0
+        while i < len(progs):
+            cand = progs[:i] + progs[i + chunk:]
+            if cand:
+                r = crashed(cand, opts)
+                if r is not None:
+                    progs, rep = cand, r
+                    shrunk = True
+                    continue  # same i now points at the next chunk
+            i += chunk
+        if not shrunk:
+            if chunk == 1:
+                break
+            n *= 2
+    return progs, rep
+
+
+def _concat(target, progs):
+    p = Prog(target, [])
+    for q in progs:
+        p.calls.extend(q.clone().calls)
+    return p
+
+
+def _test_c(p, copts, tester, duration, stats) -> Optional[str]:
+    try:
+        src = csource.write(p, copts)
+        bin_path = csource.build(src)
+    except (subprocess.CalledProcessError, OSError, ValueError):
+        return None  # e.g. no compiler on this host: skip the C phase
+    try:
+        stats.exec_runs += 1
+        rep = tester.test_c_bin(bin_path, duration)
+        return src if rep is not None else None
+    finally:
+        os.unlink(bin_path)
+
+
+# Option-simplification ladders (reference simplifyProg repro.go:426-456
+# and simplifyC:474-...): each returns a simpler candidate or None.
+
+def _drop_collide(o: ExecOpts) -> Optional[ExecOpts]:
+    return replace(o, collide=False) if o.collide else None
+
+
+def _drop_threaded(o: ExecOpts) -> Optional[ExecOpts]:
+    if not o.threaded or o.collide:
+        return None
+    return replace(o, threaded=False)
+
+
+def _drop_fault(o: ExecOpts) -> Optional[ExecOpts]:
+    if o.fault_call < 0:
+        return None
+    return replace(o, fault_call=-1, fault_nth=0)
+
+
+_PROG_SIMPLIFIES = [_drop_collide, _drop_threaded, _drop_fault]
+
+
+def _c_drop_collide(o: csource.Options) -> Optional[csource.Options]:
+    return replace(o, collide=False) if o.collide else None
+
+
+def _c_drop_threaded(o: csource.Options) -> Optional[csource.Options]:
+    if not o.threaded or o.collide:
+        return None
+    return replace(o, threaded=False)
+
+
+def _c_drop_repeat(o: csource.Options) -> Optional[csource.Options]:
+    return replace(o, repeat=False) if o.repeat else None
+
+
+def _c_drop_fault(o: csource.Options) -> Optional[csource.Options]:
+    if not o.fault:
+        return None
+    return replace(o, fault=False, fault_call=-1, fault_nth=0)
+
+
+_C_SIMPLIFIES = [_c_drop_collide, _c_drop_threaded, _c_drop_repeat,
+                 _c_drop_fault]

@@ -1,0 +1,7 @@
+"""Command-line tools mirroring the reference's tools/ directory
+(/root/reference/tools/): execprog (replay programs), stress (corpusless
+stress loop), mutate (single-program mutation), prog2c (program -> C),
+db (corpus database surgery), benchcmp (bench-series comparison HTML),
+repro (crash reproduction from a log), symbolize (report symbolization),
+fmt (description formatter). Each is `python -m syzkaller_tpu.tools.<name>`.
+"""

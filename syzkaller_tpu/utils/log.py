@@ -17,7 +17,8 @@ from typing import Deque, Optional
 _lock = threading.Lock()
 _level = 0
 _cache: Optional[Deque[str]] = None
-_cache_max_mem = 0
+_cache_mem = 0       # bytes currently retained
+_cache_max_mem = 0   # eviction bound, enforced at insert time
 _stream = sys.stderr
 
 
@@ -28,32 +29,33 @@ def set_verbosity(level: int) -> None:
 
 def enable_log_caching(max_lines: int = 100000,
                        max_mem: int = 8 << 20) -> None:
-    global _cache, _cache_max_mem
+    global _cache, _cache_max_mem, _cache_mem
     with _lock:
         _cache = deque(maxlen=max_lines)
         _cache_max_mem = max_mem
+        _cache_mem = 0
 
 
 def cached_log_output() -> str:
     with _lock:
         if _cache is None:
             return ""
-        out, total = [], 0
-        for line in reversed(_cache):
-            total += len(line)
-            if _cache_max_mem and total > _cache_max_mem:
-                break
-            out.append(line)
-        return "".join(reversed(out))
+        return "".join(_cache)
 
 
 def logf(level: int, fmt: str, *args) -> None:
+    global _cache_mem
     msg = (fmt % args) if args else fmt
     line = "%s [%d] %s\n" % (
         time.strftime("%Y/%m/%d %H:%M:%S"), level, msg)
     with _lock:
         if _cache is not None:
+            if len(_cache) == _cache.maxlen:
+                _cache_mem -= len(_cache[0])  # about to be auto-evicted
             _cache.append(line)
+            _cache_mem += len(line)
+            while _cache_mem > _cache_max_mem and len(_cache) > 1:
+                _cache_mem -= len(_cache.popleft())
     if level <= _level:
         _stream.write(line)
         _stream.flush()

@@ -202,10 +202,16 @@ def monitor_execution(merger: OutputMerger, proc,
         cmd_exited = proc is not None and proc.poll() is not None
         if merger.eof or cmd_exited:
             time.sleep(0.2)  # let the pump thread drain trailing output
+            out = merger.output(start_len)
+            # a crash report can arrive in the final flush right before
+            # exit — scan it, or a real reproducer reads as lost_connection
+            rep = parse_report(out.decode("utf-8", "replace"),
+                               ignores=ignores)
+            if rep is not None:
+                return MonitorResult(rep, out)
             rc = proc.poll() if proc is not None else 0
             lost = rc not in (0, None)
-            return MonitorResult(None, merger.output(start_len),
-                                 lost_connection=lost)
+            return MonitorResult(None, out, lost_connection=lost)
         if time.time() > deadline:
             return MonitorResult(None, merger.output(start_len),
                                  timed_out=True)
@@ -381,5 +387,4 @@ class QemuInstance(Instance):
             except (ProcessLookupError, PermissionError):
                 pass
             self.proc.wait()
-        shutil.rmtree(self.dir, ignore_errors=True)
         shutil.rmtree(self.dir, ignore_errors=True)

@@ -1,0 +1,163 @@
+"""Repro pipeline: bisection, minimization, option/C simplification
+(reference pkg/repro) — hermetic via an injected Tester."""
+
+import os
+import stat
+import tempfile
+
+from syzkaller_tpu import repro
+from syzkaller_tpu.ipc import ExecOpts
+from syzkaller_tpu.prog import get_target
+from syzkaller_tpu.prog.encoding import serialize
+from syzkaller_tpu.report import Report
+
+TARGET = get_target("linux", "amd64")
+
+# the "bug": any program containing both mkdir and rmdir crashes
+CULPRIT = """\
+r0 = open(&0:0:0=".\\x00", 0x0, 0x0)
+mkdir(&1:0:0="./dir\\x00", 0x1ff)
+dup(r0)
+rmdir(&2:0:0="./dir\\x00")
+close(r0)
+"""
+
+INNOCENT = """\
+getpid()
+getuid()
+"""
+
+
+class FakeTester(repro.Tester):
+    def __init__(self, c_crashes=True):
+        self.runs = 0
+        self.c_crashes = c_crashes
+
+    @staticmethod
+    def _is_crasher(p):
+        names = [c.meta.call_name for c in p.calls]
+        return "mkdir" in names and "rmdir" in names
+
+    def test_progs(self, progs, opts, duration):
+        self.runs += 1
+        if any(self._is_crasher(p) for p in progs):
+            return Report(title="KASAN: use-after-free in foo")
+        return None
+
+    def test_c_bin(self, bin_path, duration):
+        if self.c_crashes:
+            return Report(title="KASAN: use-after-free in foo")
+        return None
+
+
+def _log(progs):
+    out = []
+    for i, text in enumerate(progs):
+        out.append(f"executing program {i % 2}:")
+        out.append(text.strip())
+        out.append("")
+    return "\n".join(out)
+
+
+def test_repro_single_last():
+    # the last program is the crasher: single-prog extraction path
+    log = _log([INNOCENT, INNOCENT, CULPRIT])
+    tester = FakeTester()
+    res = repro.run(log, TARGET, tester)
+    assert res is not None
+    assert res.title == "KASAN: use-after-free in foo"
+    names = [c.meta.call_name for c in res.prog.calls]
+    # minimized to just the crash-relevant calls
+    assert "mkdir" in names and "rmdir" in names
+    assert "dup" not in names and "close" not in names
+    # fake tester crashes regardless of opts -> everything simplified away
+    assert not res.opts.threaded and not res.opts.collide
+    assert res.c_src is not None
+    assert not res.c_opts.repeat
+
+
+def test_repro_bisect():
+    # crasher buried mid-log, last program innocent: bisection path
+    progs = [INNOCENT] * 6 + [CULPRIT] + [INNOCENT] * 3
+    tester = FakeTester()
+    res = repro.run(_log(progs), TARGET, tester)
+    assert res is not None
+    names = [c.meta.call_name for c in res.prog.calls]
+    assert "mkdir" in names and "rmdir" in names
+
+
+MKDIR_ONLY = 'mkdir(&1:0:0="./dir\\x00", 0x1ff)\n'
+RMDIR_ONLY = 'rmdir(&2:0:0="./dir\\x00")\n'
+
+
+class SequenceTester(repro.Tester):
+    """Crashes only when mkdir and rmdir appear in *different* programs —
+    forces the sequence-fallback path (concatenation breaks the repro)."""
+
+    def test_progs(self, progs, opts, duration):
+        has_mk = [("mkdir" in [c.meta.call_name for c in p.calls])
+                  for p in progs]
+        has_rm = [("rmdir" in [c.meta.call_name for c in p.calls])
+                  for p in progs]
+        crosses = any(m and not r for m, r in zip(has_mk, has_rm)) and \
+            any(r and not m for m, r in zip(has_mk, has_rm))
+        return Report(title="sequence bug") if crosses else None
+
+    def test_c_bin(self, bin_path, duration):
+        return None
+
+
+def test_repro_sequence_fallback():
+    log = _log([INNOCENT, MKDIR_ONLY, INNOCENT, RMDIR_ONLY, INNOCENT])
+    res = repro.run(log, TARGET, SequenceTester())
+    assert res is not None
+    assert res.prog is None  # no single-program reproducer exists
+    assert len(res.progs) == 2  # ddmin kept exactly the crashing pair
+    names = [{c.meta.call_name for c in p.calls} for p in res.progs]
+    assert {"mkdir"} <= names[0] and {"rmdir"} <= names[1]
+
+
+def test_repro_no_crash():
+    tester = FakeTester()
+    res = repro.run(_log([INNOCENT, INNOCENT]), TARGET, tester)
+    assert res is None
+    assert tester.runs > 0
+
+
+def test_repro_no_c():
+    tester = FakeTester(c_crashes=False)
+    res = repro.run(_log([CULPRIT]), TARGET, tester)
+    assert res is not None
+    assert res.c_src is None
+
+
+def test_repro_empty_log():
+    assert repro.run("no programs here", TARGET, FakeTester()) is None
+
+
+def test_vmtester_plumbing():
+    """VMTester end-to-end over the local VM backend: a stand-in
+    'python' binary prints a KASAN oops to the console; the monitor must
+    recognize it and the pipeline must produce a reproducer."""
+    from syzkaller_tpu.vm import LocalPool, VMConfig
+
+    fake = tempfile.NamedTemporaryFile(
+        "w", suffix=".sh", delete=False)
+    fake.write("""#!/bin/sh
+echo "BUG: KASAN: use-after-free in vfs_rmdir+0x100/0x200"
+echo "Read of size 8 at addr ffff8800b92ca3b0 by task a.out/1234"
+sleep 30
+""")
+    fake.close()
+    os.chmod(fake.name, stat.S_IRWXU)
+    try:
+        from syzkaller_tpu.prog.encoding import deserialize
+
+        pool = LocalPool(VMConfig(type="local", count=1))
+        tester = repro.VMTester(pool, python=fake.name)
+        rep = tester.test_progs([deserialize(TARGET, CULPRIT)],
+                                ExecOpts(), duration=20.0)
+        assert rep is not None
+        assert rep.title == "KASAN: use-after-free Read in vfs_rmdir"
+    finally:
+        os.unlink(fake.name)
