@@ -1,0 +1,119 @@
+"""Canonical formatter for syscall description files.
+
+Re-serializes the parsed AST back to the description language's canonical
+layout (reference /root/reference/pkg/ast/format.go: tab-separated struct
+fields, `name(args) ret` calls, brace-wrapped struct/union bodies).
+Formatting is idempotent: format(parse(format(parse(x)))) == format(parse(x)).
+"""
+
+from __future__ import annotations
+
+from typing import List, Union
+
+from . import ast
+
+
+def _quote(s: str) -> str:
+    """Inverse of parser._unescape (unicode_escape with quote handling)."""
+    body = (s.encode("unicode_escape").decode("ascii")
+            .replace('"', '\\"'))
+    return f'"{body}"'
+
+
+def _expr(e) -> str:
+    if isinstance(e, ast.IntLit):
+        v = e.value
+        return hex(v) if v >= 10 else str(v)
+    if isinstance(e, ast.Ident):
+        return e.name
+    if isinstance(e, ast.StrLit):
+        return _quote(e.value)
+    if isinstance(e, ast.IntRange):
+        return f"{_expr(e.begin)}:{_expr(e.end)}"
+    if isinstance(e, ast.TypeExpr):
+        return _type(e)
+    raise TypeError(f"unknown expr node {e!r}")
+
+
+def _type(t: ast.TypeExpr) -> str:
+    s = t.name
+    if t.args:
+        s += "[" + ", ".join(_expr(a) for a in t.args) + "]"
+    if t.bitfield_len is not None:
+        s += ":" + _expr(t.bitfield_len)
+    return s
+
+
+def _call(c: ast.CallDef) -> str:
+    args = ", ".join(f"{f.name} {_type(f.typ)}" for f in c.fields)
+    s = f"{c.name}({args})"
+    if c.ret is not None:
+        s += " " + _type(c.ret)
+    return s
+
+
+def _struct(s: ast.StructDef) -> List[str]:
+    op, cl = ("[", "]") if s.is_union else ("{", "}")
+    lines = [f"{s.name} {op}"]
+    width = max((len(f.name) for f in s.fields), default=0)
+    for f in s.fields:
+        lines.append(f"\t{f.name.ljust(width)}\t{_type(f.typ)}")
+    tail = cl
+    if s.attrs:
+        tail += " [" + ", ".join(s.attrs) + "]"
+    lines.append(tail)
+    return lines
+
+
+def format_node(n: ast.Node) -> List[str]:
+    if isinstance(n, ast.CallDef):
+        return [_call(n)]
+    if isinstance(n, ast.ResourceDef):
+        s = f"resource {n.name}[{_type(n.base)}]"
+        if n.values:
+            s += ": " + ", ".join(_expr(v) for v in n.values)
+        return [s]
+    if isinstance(n, ast.FlagsDef):
+        return [f"{n.name} = " + ", ".join(_expr(v) for v in n.values)]
+    if isinstance(n, ast.StrFlagsDef):
+        return [f"{n.name} = " + ", ".join(_quote(v) for v in n.values)]
+    if isinstance(n, ast.StructDef):
+        return _struct(n)
+    if isinstance(n, ast.DefineDef):
+        return [f"define {n.name} {n.expr}"]
+    if isinstance(n, ast.IncludeDef):
+        return [f"include <{n.path}>"]
+    raise TypeError(f"unknown node {n!r}")
+
+
+def format_description(desc: ast.Description) -> str:
+    """Canonical text: one blank line between definition groups; struct
+    and union bodies separated from scalar definitions."""
+    out: List[str] = []
+    prev_kind = None
+    for n in desc.nodes:
+        kind = type(n).__name__
+        block = isinstance(n, ast.StructDef)
+        if out and (block or kind != prev_kind):
+            out.append("")
+        out.extend(format_node(n))
+        prev_kind = kind
+    return "\n".join(out) + "\n"
+
+
+def format_file(path: str, write: bool = False) -> Union[str, bool]:
+    """Format one .txt description file. With write=True, rewrites the
+    file in place and returns whether it changed."""
+    from .parser import parse
+
+    with open(path) as f:
+        src = f.read()
+    text = format_description(parse(src, path))
+    parse(text, path)  # never overwrite with text that doesn't re-parse
+    if not write:
+        return text
+    if text != src:
+        with open(path, "w") as f:
+            f.write(text)
+        return True
+    return False

@@ -5,3 +5,29 @@ db (corpus database surgery), benchcmp (bench-series comparison HTML),
 repro (crash reproduction from a log), symbolize (report symbolization),
 fmt (description formatter). Each is `python -m syzkaller_tpu.tools.<name>`.
 """
+
+from __future__ import annotations
+
+import sys
+from typing import List
+
+
+def load_corpus_db(target, path: str) -> List:
+    """Parse every program stored in a corpus.db, skipping (and
+    reporting) entries that no longer deserialize."""
+    from ..db import DB
+    from ..prog.encoding import deserialize
+
+    progs = []
+    skipped = 0
+    with DB.open(path) as db:
+        for _, val in db.items():
+            try:
+                progs.append(deserialize(target,
+                                         val.decode("utf-8", "replace")))
+            except Exception:
+                skipped += 1
+    if skipped:
+        print(f"corpus {path}: skipped {skipped} unparsable programs",
+              file=sys.stderr)
+    return progs

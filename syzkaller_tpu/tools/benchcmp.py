@@ -1,0 +1,112 @@
+"""Compare manager bench files (reference
+/root/reference/tools/syz-benchcmp/benchcmp.go:44-52: graphs of coverage /
+corpus / exec-total / crash-types over time for several runs).
+
+Input: one or more JSON-lines files written by `Manager -bench`
+(one object per minute: {"ts": ..., "signal": ..., "corpus": ...,
+"exec_total": ..., "crash_types": ...}).  Output: a single standalone
+HTML file with one inline-SVG line chart per metric, one polyline per
+input file — no external plotting dependencies, same spirit as the
+reference's self-contained HTML output.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Tuple
+
+GRAPHS = ("signal", "corpus", "exec_total", "crash_types")
+COLORS = ("#1f77b4", "#d62728", "#2ca02c", "#9467bd", "#ff7f0e",
+          "#8c564b", "#e377c2", "#7f7f7f")
+
+
+def load_series(path: str) -> Dict[str, List[Tuple[float, float]]]:
+    """metric -> [(minutes since start, value)]."""
+    out: Dict[str, List[Tuple[float, float]]] = {g: [] for g in GRAPHS}
+    t0 = None
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            ts = float(rec.get("ts", 0))
+            if t0 is None:
+                t0 = ts
+            for g in GRAPHS:
+                if g in rec:
+                    out[g].append(((ts - t0) / 60.0, float(rec[g])))
+    return out
+
+
+def _svg_chart(title: str, series: List[Tuple[str, List[Tuple[float, float]]]],
+               w: int = 640, h: int = 320) -> str:
+    pad = 48
+    xs = [x for _, pts in series for x, _ in pts]
+    ys = [y for _, pts in series for _, y in pts]
+    xmax = max(xs, default=1.0) or 1.0
+    ymax = max(ys, default=1.0) or 1.0
+    parts = [f'<svg width="{w}" height="{h}" '
+             f'style="border:1px solid #ccc;margin:8px">',
+             f'<text x="{w // 2}" y="16" text-anchor="middle" '
+             f'font-weight="bold">{title}</text>']
+    # axes + ticks
+    parts.append(f'<line x1="{pad}" y1="{h - pad}" x2="{w - 8}" '
+                 f'y2="{h - pad}" stroke="#888"/>')
+    parts.append(f'<line x1="{pad}" y1="{h - pad}" x2="{pad}" y2="24" '
+                 f'stroke="#888"/>')
+    for i in range(5):
+        yv = ymax * i / 4
+        yp = (h - pad) - (h - pad - 24) * i / 4
+        parts.append(f'<text x="{pad - 4}" y="{yp + 4:.0f}" '
+                     f'text-anchor="end" font-size="10">{yv:.0f}</text>')
+        xv = xmax * i / 4
+        xp = pad + (w - 8 - pad) * i / 4
+        parts.append(f'<text x="{xp:.0f}" y="{h - pad + 14}" '
+                     f'text-anchor="middle" font-size="10">{xv:.0f}m</text>')
+    for i, (name, pts) in enumerate(series):
+        color = COLORS[i % len(COLORS)]
+        if not pts:
+            continue
+        coords = " ".join(
+            f"{pad + (w - 8 - pad) * x / xmax:.1f},"
+            f"{(h - pad) - (h - pad - 24) * y / ymax:.1f}" for x, y in pts)
+        parts.append(f'<polyline points="{coords}" fill="none" '
+                     f'stroke="{color}" stroke-width="1.5"/>')
+    # legend
+    for i, (name, _) in enumerate(series):
+        color = COLORS[i % len(COLORS)]
+        parts.append(f'<rect x="{pad + 8}" y="{28 + 14 * i}" width="10" '
+                     f'height="10" fill="{color}"/>')
+        parts.append(f'<text x="{pad + 22}" y="{37 + 14 * i}" '
+                     f'font-size="11">{name}</text>')
+    parts.append("</svg>")
+    return "".join(parts)
+
+
+def render(files: List[str]) -> str:
+    data = [(os.path.basename(p), load_series(p)) for p in files]
+    charts = [_svg_chart(g, [(name, d[g]) for name, d in data])
+              for g in GRAPHS]
+    return ("<!doctype html><html><head><meta charset='utf-8'>"
+            "<title>syz-benchcmp</title></head><body>"
+            + "\n".join(charts) + "</body></html>\n")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="syz-benchcmp")
+    ap.add_argument("files", nargs="+", help="manager -bench JSON files")
+    ap.add_argument("-o", "--out", default="bench.html")
+    args = ap.parse_args(argv)
+    html = render(args.files)
+    with open(args.out, "w") as f:
+        f.write(html)
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

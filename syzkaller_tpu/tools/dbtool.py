@@ -1,0 +1,102 @@
+"""Corpus database tool (reference /root/reference/tools/syz-db/syz-db.go:
+pack a directory of programs into corpus.db, unpack a db into a directory,
+merge several dbs).  Keys are the sha1 of the serialized program text, the
+same keying the manager uses, so packed dbs drop straight into a workdir.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def pack(target, srcdir: str, dbpath: str) -> int:
+    from ..db import DB
+    from ..prog.encoding import deserialize, serialize
+    from ..utils.hash import hash_str
+
+    keys = set()
+    with DB.open(dbpath) as db:
+        for name in sorted(os.listdir(srcdir)):
+            path = os.path.join(srcdir, name)
+            if not os.path.isfile(path):
+                continue
+            with open(path, "r", errors="replace") as f:
+                text = f.read()
+            if target is not None:
+                try:
+                    text = serialize(deserialize(target, text))
+                except Exception as e:
+                    print(f"skipping {name}: {e}", file=sys.stderr)
+                    continue
+            key = hash_str(text.encode()).encode()
+            db.save(key, text.encode())
+            keys.add(key)
+        db.flush()
+    return len(keys)
+
+
+def unpack(dbpath: str, dstdir: str) -> int:
+    from ..db import DB
+
+    os.makedirs(dstdir, exist_ok=True)
+    n = 0
+    with DB.open(dbpath) as db:
+        for key, val in db.items():
+            with open(os.path.join(dstdir, key.decode()), "wb") as f:
+                f.write(val)
+            n += 1
+    return n
+
+
+def merge(dst: str, srcs) -> int:
+    from ..db import DB
+
+    n = 0
+    with DB.open(dst) as out:
+        for path in srcs:
+            with DB.open(path) as src:
+                for key, val in src.items():
+                    if key not in out:
+                        out.save(key, val)
+                        n += 1
+        out.flush()
+        out.compact()
+    return n
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="syz-db")
+    ap.add_argument("-os", default="linux")
+    ap.add_argument("-arch", default="amd64")
+    ap.add_argument("-no-verify", dest="no_verify", action="store_true",
+                    help="pack without parsing programs")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("pack");   p.add_argument("dir"); p.add_argument("db")
+    p = sub.add_parser("unpack"); p.add_argument("db");  p.add_argument("dir")
+    p = sub.add_parser("merge")
+    p.add_argument("dst"); p.add_argument("srcs", nargs="+")
+    p = sub.add_parser("list");   p.add_argument("db")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "pack":
+        target = None
+        if not args.no_verify:
+            from ..prog import get_target
+            target = get_target(args.os, args.arch)
+        print(f"packed {pack(target, args.dir, args.db)} programs")
+    elif args.cmd == "unpack":
+        print(f"unpacked {unpack(args.db, args.dir)} programs")
+    elif args.cmd == "merge":
+        print(f"merged {merge(args.dst, args.srcs)} new programs")
+    elif args.cmd == "list":
+        from ..db import DB
+        with DB.open(args.db) as db:
+            for key, val in db.items():
+                print(key.decode(), len(val))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,47 @@
+"""Format syscall description files in place (reference
+/root/reference/tools/syz-fmt/fmt.go).  Note: like a code formatter run
+through the AST, comments are not preserved — use -check to diff without
+writing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="syz-fmt")
+    ap.add_argument("paths", nargs="+",
+                    help=".txt files or directories of descriptions")
+    ap.add_argument("-check", action="store_true",
+                    help="print formatted text to stdout, don't write")
+    args = ap.parse_args(argv)
+
+    from ..descriptions.format import format_file
+
+    files = []
+    for p in args.paths:
+        if os.path.isdir(p):
+            files.extend(sorted(glob.glob(os.path.join(p, "*.txt"))))
+        else:
+            files.append(p)
+    rc = 0
+    for path in files:
+        try:
+            result = format_file(path, write=not args.check)
+        except Exception as e:
+            print(f"{path}: {e}", file=sys.stderr)
+            rc = 1
+            continue
+        if args.check:
+            sys.stdout.write(result)
+        elif result:
+            print(f"reformatted {path}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,92 @@
+"""Mutate a single program and print the result (reference
+/root/reference/tools/syz-mutate/mutate.go).  This is BASELINE config #1's
+CPU measurement tool: `-loop N` times the host-CPU tree mutator;
+`-device` runs the same workload through the batched TPU kernel so the
+two paths can be compared on identical distributions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+
+def _bench_device(target, n: int, B: int = 4096, C: int = 16) -> float:
+    """Batched device mutation throughput over ~n programs total."""
+    import jax
+
+    from ..descriptions.tables import get_tables
+    from ..ops import mutation as dmut
+    from ..ops.dtables import build_device_tables
+    from ..prog.tensor import TensorFormat
+
+    tables = get_tables(target)
+    fmt = TensorFormat.for_tables(tables, max_calls=C)
+    dt = build_device_tables(tables, fmt)
+    iters = max(1, n // B)
+
+    key = jax.random.PRNGKey(0)
+    cid, sval, data = dmut.generate_batch(key, dt, B=B, C=C)
+    step = jax.jit(lambda k, c, s, d: dmut.mutate_batch(k, dt, c, s, d))
+    out = step(key, cid, sval, data)            # compile
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        out = step(jax.random.fold_in(key, i), *out)
+    jax.block_until_ready(out)
+    return B * iters / (time.perf_counter() - t0)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="syz-mutate")
+    ap.add_argument("file", nargs="?", help="program file (default stdin)")
+    ap.add_argument("-os", default="linux")
+    ap.add_argument("-arch", default="amd64")
+    ap.add_argument("-seed", type=int, default=None)
+    ap.add_argument("-len", dest="ncalls", type=int, default=30,
+                    help="max program length")
+    ap.add_argument("-corpus", help="corpus.db to splice from")
+    ap.add_argument("-loop", type=int, default=0,
+                    help="benchmark: mutate N times, print progs/sec")
+    ap.add_argument("-device", action="store_true",
+                    help="benchmark on the TPU mutation kernel instead")
+    args = ap.parse_args(argv)
+
+    from ..prog import get_target
+    from ..prog.encoding import deserialize, serialize
+    from ..prog.generation import generate
+    from ..prog.mutation import mutate
+
+    target = get_target(args.os, args.arch)
+    if args.file:
+        with open(args.file) as f:
+            p = deserialize(target, f.read())
+    elif not sys.stdin.isatty():
+        p = deserialize(target, sys.stdin.read())
+    else:
+        p = generate(target, args.seed or 0, args.ncalls)
+
+    from . import load_corpus_db
+    corpus = load_corpus_db(target, args.corpus) if args.corpus else []
+
+    if args.loop:
+        if args.device:
+            rate = _bench_device(target, n=args.loop)
+        else:
+            t0 = time.perf_counter()
+            for i in range(args.loop):
+                q = p.clone()
+                mutate(q, (args.seed or 0) * 1000003 + i, args.ncalls,
+                       corpus=corpus or None)
+            rate = args.loop / (time.perf_counter() - t0)
+        print(f"{rate:.1f} progs/sec", file=sys.stderr)
+        return 0
+
+    mutate(p, args.seed, args.ncalls, corpus=corpus or None)
+    sys.stdout.write(serialize(p))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

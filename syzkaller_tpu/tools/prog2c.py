@@ -1,0 +1,41 @@
+"""Render a serialized program as a standalone C reproducer
+(reference /root/reference/tools/syz-prog2c/prog2c.go)."""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="syz-prog2c")
+    ap.add_argument("file", nargs="?", help="program file (default stdin)")
+    ap.add_argument("-os", default="linux")
+    ap.add_argument("-arch", default="amd64")
+    ap.add_argument("-threaded", action="store_true")
+    ap.add_argument("-collide", action="store_true")
+    ap.add_argument("-repeat", action="store_true")
+    ap.add_argument("-procs", type=int, default=1)
+    ap.add_argument("-sandbox", default="")
+    ap.add_argument("-fault-call", dest="fault_call", type=int, default=-1)
+    ap.add_argument("-fault-nth", dest="fault_nth", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    from ..csource import Options, write
+    from ..prog import get_target
+    from ..prog.encoding import deserialize
+
+    target = get_target(args.os, args.arch)
+    data = (open(args.file).read() if args.file else sys.stdin.read())
+    p = deserialize(target, data)
+    opts = Options(threaded=args.threaded, collide=args.collide,
+                   repeat=args.repeat, procs=args.procs,
+                   sandbox=args.sandbox,
+                   fault=args.fault_call >= 0,
+                   fault_call=args.fault_call, fault_nth=args.fault_nth)
+    sys.stdout.write(write(p, opts))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,101 @@
+"""Corpus-driven stress loop without a manager (reference
+/root/reference/tools/syz-stress/stress.go): each proc repeatedly executes
+either a mutation of a random corpus program or a freshly generated one,
+with no triage/feedback — pure load generation for soak-testing a kernel
+or the executor itself.
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import sys
+import threading
+from typing import List
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="syz-stress")
+    ap.add_argument("-os", default="linux")
+    ap.add_argument("-arch", default="amd64")
+    ap.add_argument("-corpus", help="corpus.db of seed programs")
+    ap.add_argument("-procs", type=int, default=2)
+    ap.add_argument("-len", dest="ncalls", type=int, default=30)
+    ap.add_argument("-executed", type=int, default=0,
+                    help="stop after N executions (0 = forever)")
+    ap.add_argument("-sandbox", default="none")
+    ap.add_argument("-threaded", action="store_true")
+    ap.add_argument("-mock", action="store_true",
+                    help="mock executor (no real syscalls)")
+    ap.add_argument("-seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    from ..ipc import Env, EnvConfig, ExecOpts, MockEnv
+    from ..prog import get_target
+    from ..prog.generation import generate
+    from ..prog.mutation import mutate
+    from ..prog.prio import build_choice_table
+
+    from . import load_corpus_db
+
+    target = get_target(args.os, args.arch)
+    corpus = load_corpus_db(target, args.corpus) if args.corpus else []
+    ct = build_choice_table(target)
+    opts = ExecOpts(threaded=args.threaded)
+
+    count = 0
+    count_lock = threading.Lock()
+    stop = threading.Event()
+    errors: List[BaseException] = []
+
+    def proc(pid: int) -> None:
+        nonlocal count
+        try:
+            _proc(pid)
+        except BaseException as e:  # a dead proc must stop the run
+            errors.append(e)
+            print(f"proc {pid} died: {e!r}", file=sys.stderr)
+            stop.set()
+
+    def _proc(pid: int) -> None:
+        nonlocal count
+        rng = random.Random(args.seed * 1000 + pid)
+        env = (MockEnv(target, pid=pid) if args.mock
+               else Env(target, pid=pid,
+                        config=EnvConfig(sandbox=args.sandbox)))
+        try:
+            while not stop.is_set():
+                # 4:1 mutate:generate when a corpus exists (stress.go)
+                if corpus and rng.randrange(5) != 0:
+                    p = rng.choice(corpus).clone()
+                    mutate(p, rng.randrange(1 << 30), args.ncalls,
+                           ct=ct, corpus=corpus)
+                else:
+                    p = generate(target, rng.randrange(1 << 30),
+                                 args.ncalls, ct=ct)
+                env.exec(opts, p)
+                with count_lock:
+                    count += 1
+                    if args.executed and count >= args.executed:
+                        stop.set()
+        finally:
+            env.close()
+
+    threads = [threading.Thread(target=proc, args=(i,), daemon=True)
+               for i in range(args.procs)]
+    for t in threads:
+        t.start()
+    try:
+        while not stop.is_set():
+            if not stop.wait(10.0):
+                print(f"executed {count}", flush=True)
+    except KeyboardInterrupt:
+        stop.set()
+    for t in threads:
+        t.join(timeout=30.0)
+    print(f"executed {count} programs", flush=True)
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

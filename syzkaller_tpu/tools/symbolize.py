@@ -1,0 +1,42 @@
+"""Symbolize a kernel crash report (reference
+/root/reference/tools/syz-symbolize/symbolize.go): parses the report,
+rewrites stack-trace PCs to file:line via the vmlinux symbol table +
+addr2line, prints crash title and guilty file.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="syz-symbolize")
+    ap.add_argument("file", help="console log / crash report file")
+    ap.add_argument("-vmlinux", help="kernel image with debug info")
+    args = ap.parse_args(argv)
+
+    from .. import report as rep
+
+    with open(args.file, "r", errors="replace") as f:
+        output = f.read()
+
+    r = rep.parse(output)
+    if r is None:
+        print("no crash found in the log", file=sys.stderr)
+        return 1
+    print(f"TITLE: {r.title}")
+    guilty = rep.extract_guilty_file(r.report)
+    if guilty:
+        print(f"GUILTY FILE: {guilty}")
+    text = r.report
+    if args.vmlinux:
+        from ..report.symbolize import Symbolizer
+        text = Symbolizer(args.vmlinux).symbolize_report(text)
+    print()
+    print(text)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -119,3 +119,29 @@ def test_parse_log_truncated():
     entries = parse_log(TARGET, log)
     assert len(entries) == 1
     assert len(entries[0].p.calls) >= 1
+
+
+def test_c_string_hex_digit_after_escape():
+    # "\x04B" in C is ONE byte (0x4b, greedy hex escape); the emitter must
+    # use 3-digit octal so a following hex-digit char stays a separate byte.
+    data = bytes([0x04]) + b"B" + bytes([0xFF]) + b"7" + b'"\\'
+    lit = csource._c_string(data)
+    assert "\\x" not in lit
+    assert lit == '"\\004B\\3777\\"\\\\"'
+    # round-trip through an actual C compiler
+    src = ("#include <string.h>\n#include <stdio.h>\n"
+           "int main() {\n"
+           f"  const char s[] = {lit};\n"
+           f"  if (sizeof(s) - 1 != {len(data)}) return 1;\n"
+           f"  if (memcmp(s, \"\\004B\\377\\067\\042\\134\", {len(data)})) "
+           "return 2;\n"
+           "  puts(\"OK\"); return 0;\n}\n")
+    import subprocess, tempfile
+    with tempfile.TemporaryDirectory() as d:
+        c = os.path.join(d, "t.c")
+        with open(c, "w") as f:
+            f.write(src)
+        exe = os.path.join(d, "t")
+        subprocess.check_call(["cc", "-o", exe, c])
+        out = subprocess.run([exe], capture_output=True, text=True)
+        assert out.returncode == 0 and out.stdout.strip() == "OK"

@@ -1,0 +1,200 @@
+"""Tools suite (reference tools/): syz-db, syz-prog2c, syz-mutate,
+syz-stress, syz-benchcmp, syz-fmt, syz-symbolize equivalents."""
+
+import json
+import os
+import random
+import tempfile
+
+import pytest
+
+from syzkaller_tpu.db import DB
+from syzkaller_tpu.prog import get_target
+from syzkaller_tpu.prog.encoding import deserialize, serialize
+from syzkaller_tpu.prog.generation import generate
+from syzkaller_tpu.tools import benchcmp, dbtool, fmt, mutate, prog2c, stress
+from syzkaller_tpu.utils.hash import hash_str
+
+TARGET = get_target("linux", "amd64")
+
+
+def _progs(n, seed=0):
+    return [generate(TARGET, seed * 100 + i, 8) for i in range(n)]
+
+
+class TestDbTool:
+    def test_pack_unpack_roundtrip(self, tmp_path):
+        src = tmp_path / "src"
+        src.mkdir()
+        texts = sorted(serialize(p) for p in _progs(5))
+        for i, t in enumerate(texts):
+            (src / f"prog{i}").write_text(t)
+        db_path = str(tmp_path / "corpus.db")
+        assert dbtool.pack(TARGET, str(src), db_path) == len(set(texts))
+
+        dst = tmp_path / "dst"
+        n = dbtool.unpack(db_path, str(dst))
+        assert n == len(set(texts))
+        got = sorted((dst / f).read_text() for f in os.listdir(dst))
+        assert got == sorted(set(texts))
+        # keys are the manager's sha1 keying
+        for f in os.listdir(dst):
+            assert hash_str((dst / f).read_bytes()) == f
+
+    def test_pack_skips_garbage(self, tmp_path, capsys):
+        src = tmp_path / "src"
+        src.mkdir()
+        (src / "good").write_text(serialize(_progs(1)[0]))
+        (src / "bad").write_text("not_a_syscall(1, 2)\n")
+        assert dbtool.pack(TARGET, str(src), str(tmp_path / "c.db")) == 1
+
+    def test_merge(self, tmp_path):
+        texts = [serialize(p) for p in _progs(6)]
+        a, b, dst = (str(tmp_path / x) for x in ("a.db", "b.db", "dst.db"))
+        with DB.open(a) as db:
+            for t in texts[:4]:
+                db.save(hash_str(t.encode()).encode(), t.encode())
+            db.flush()
+        with DB.open(b) as db:
+            for t in texts[2:]:
+                db.save(hash_str(t.encode()).encode(), t.encode())
+            db.flush()
+        dbtool.merge(dst, [a, b])
+        with DB.open(dst) as db:
+            assert len(db) == len(set(texts))
+
+    def test_cli_list(self, tmp_path, capsys):
+        db_path = str(tmp_path / "c.db")
+        t = serialize(_progs(1)[0])
+        with DB.open(db_path) as db:
+            db.save(b"k1", t.encode())
+            db.flush()
+        assert dbtool.main(["list", db_path]) == 0
+        assert "k1" in capsys.readouterr().out
+
+
+class TestProg2C:
+    def test_emits_compilable_looking_c(self, tmp_path, capsys):
+        p = _progs(1)[0]
+        f = tmp_path / "p.prog"
+        f.write_text(serialize(p))
+        assert prog2c.main([str(f)]) == 0
+        out = capsys.readouterr().out
+        assert "int main" in out
+        assert "syscall" in out
+
+    def test_threaded_option(self, tmp_path, capsys):
+        f = tmp_path / "p.prog"
+        f.write_text(serialize(_progs(1)[0]))
+        assert prog2c.main([str(f), "-threaded"]) == 0
+        assert "pthread" in capsys.readouterr().out
+
+
+class TestMutateTool:
+    def test_mutates_given_prog(self, tmp_path, capsys):
+        p = _progs(1)[0]
+        f = tmp_path / "p.prog"
+        f.write_text(serialize(p))
+        assert mutate.main([str(f), "-seed", "7"]) == 0
+        out = capsys.readouterr().out
+        deserialize(TARGET, out)  # output parses back
+
+    def test_seed_determinism(self, tmp_path, capsys):
+        f = tmp_path / "p.prog"
+        f.write_text(serialize(_progs(1)[0]))
+        outs = []
+        for _ in range(2):
+            mutate.main([str(f), "-seed", "3"])
+            outs.append(capsys.readouterr().out)
+        assert outs[0] == outs[1]
+
+    def test_loop_bench(self, tmp_path, capsys):
+        f = tmp_path / "p.prog"
+        f.write_text(serialize(_progs(1)[0]))
+        assert mutate.main([str(f), "-seed", "1", "-loop", "20"]) == 0
+        assert "progs/sec" in capsys.readouterr().err
+
+
+class TestStress:
+    def test_mock_stress_run(self, tmp_path, capsys):
+        db_path = str(tmp_path / "c.db")
+        with DB.open(db_path) as db:
+            for p in _progs(3):
+                t = serialize(p)
+                db.save(hash_str(t.encode()).encode(), t.encode())
+            db.flush()
+        rc = stress.main(["-mock", "-corpus", db_path, "-procs", "2",
+                          "-executed", "25", "-seed", "1"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "executed" in out
+
+
+class TestBenchcmp:
+    def test_render_html(self, tmp_path):
+        files = []
+        for run in range(2):
+            path = tmp_path / f"bench{run}.json"
+            with open(path, "w") as f:
+                for minute in range(5):
+                    f.write(json.dumps({
+                        "ts": 1000 + 60 * minute,
+                        "signal": 100 * (minute + run),
+                        "corpus": 10 * minute,
+                        "exec_total": 1000 * minute,
+                        "crash_types": run,
+                    }) + "\n")
+            files.append(str(path))
+        html = benchcmp.render(files)
+        assert html.count("<svg") == 4
+        assert "signal" in html and "crash_types" in html
+        out = str(tmp_path / "out.html")
+        assert benchcmp.main(files + ["-o", out]) == 0
+        assert os.path.exists(out)
+
+
+class TestFmt:
+    SRC = ("resource fd[int32]: -1\n"
+           "open(file   ptr[in, filename],flags flags[oflags]) fd\n"
+           "oflags=0x1,0x2,OTHER\n"
+           "point {\n"
+           "  x  int32\n"
+           "  y  int64\n"
+           "}\n")
+
+    def test_format_idempotent(self, tmp_path):
+        f = tmp_path / "d.txt"
+        f.write_text(self.SRC)
+        first = fmt.main([str(f)])
+        assert first == 0
+        once = f.read_text()
+        assert "resource fd[int32]: -1" in once
+        fmt.main([str(f)])
+        assert f.read_text() == once
+
+    def test_string_escapes_roundtrip(self, tmp_path):
+        from syzkaller_tpu.descriptions.format import format_description
+        from syzkaller_tpu.descriptions.parser import parse
+        src = 'open(file ptr[in, string["a\\"b\\n"]]) fd\n'
+        once = format_description(parse(src))
+        assert format_description(parse(once)) == once
+
+    def test_write_refuses_corruption(self, tmp_path, monkeypatch):
+        # format_file must never overwrite a file with unparsable output
+        import syzkaller_tpu.descriptions.format as dfmt
+        f = tmp_path / "d.txt"
+        f.write_text(self.SRC)
+        monkeypatch.setattr(dfmt, "format_description",
+                            lambda d: '"""broken')
+        with pytest.raises(Exception):
+            dfmt.format_file(str(f), write=True)
+        assert f.read_text() == self.SRC
+
+    def test_formatted_still_compiles(self, tmp_path):
+        from syzkaller_tpu.descriptions.parser import parse
+        f = tmp_path / "d.txt"
+        f.write_text(self.SRC)
+        fmt.main([str(f)])
+        desc = parse(f.read_text(), str(f))
+        names = {type(n).__name__ for n in desc.nodes}
+        assert "CallDef" in names and "StructDef" in names
