@@ -1,0 +1,251 @@
+"""Constant extraction against real kernel headers (syz-extract equivalent).
+
+Plays the role of the reference's sys/syz-extract (reference:
+/root/reference/sys/syz-extract/fetch.go:20-95): for every constant
+identifier a description file references (flag values, const[...] args,
+resource seed values, plus the __NR_* number of every non-pseudo syscall),
+compile a C probe that prints the values, and merge them into
+consts_<arch>.json.
+
+Unresolvable identifiers are discovered the same way the reference does it:
+compile, parse the compiler's "'FOO' undeclared" diagnostics, drop those
+names, retry.  Calls whose __NR_* is missing simply stay unsupported at
+compile time (compiler.py records them), matching the reference's
+disabled-syscall behavior.
+
+Usage:  python -m syzkaller_tpu.descriptions.extract [--arch amd64] [files...]
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+from typing import Dict, Iterable, List, Set, Tuple
+
+from . import ast
+from .parser import parse_files
+
+DEFAULT_INCLUDES = [
+    "sys/syscall.h",
+    "sys/types.h",
+    "sys/stat.h",
+    "sys/mman.h",
+    "sys/socket.h",
+    "sys/ioctl.h",
+    "sys/time.h",
+    "sys/resource.h",
+    "sys/wait.h",
+    "fcntl.h",
+    "unistd.h",
+    "signal.h",
+    "sched.h",
+    "errno.h",
+]
+
+_UNDECLARED_RE = re.compile(
+    # gcc: "'FOO' undeclared"; clang: "use of undeclared identifier 'FOO'"
+    r"['‘]([A-Za-z_][A-Za-z0-9_]*)['’] undeclared"
+    r"|undeclared identifier ['‘]([A-Za-z_][A-Za-z0-9_]*)['’]")
+
+
+def _undeclared(stderr: str):
+    return {a or b for a, b in _UNDECLARED_RE.findall(stderr)}
+
+# Type-language keywords that can appear as bare ident args but never name
+# C constants (ptr/buffer directions, int bases, builtin types).
+_TYPE_KEYWORDS = {
+    "in", "out", "inout", "opt", "intptr", "int8", "int16", "int32", "int64",
+    "int16be", "int32be", "int64be", "bool8", "const", "flags", "len",
+    "bytesize", "bytesize2", "bytesize4", "bytesize8", "proc", "csum",
+    "inet", "pseudo", "fileoff", "vma", "ptr", "buffer", "string",
+    "stringnoz", "filename", "text", "array", "parent",
+    "x86_real", "x86_16", "x86_32", "x86_64", "arm64",
+}
+
+
+def collect_idents(desc: ast.Description) -> Tuple[Set[str], Set[str], List[str]]:
+    """Returns (const_names, syscall_names, includes) referenced by `desc`."""
+    consts: Set[str] = set()
+    calls: Set[str] = set()
+    includes: List[str] = []
+
+    def walk_type(te: ast.TypeExpr) -> None:
+        args = te.args
+        # len[field]/bytesize[field]/csum[field,...] name sibling FIELDS in
+        # their first arg, not constants.
+        if te.name in ("len", "bytesize", "bytesize2", "bytesize4",
+                       "bytesize8", "csum") and args:
+            args = args[1:]
+        for a in args:
+            if isinstance(a, ast.Ident):
+                consts.add(a.name)
+            elif isinstance(a, ast.IntRange):
+                for e in (a.begin, a.end):
+                    if isinstance(e, ast.Ident):
+                        consts.add(e.name)
+            elif isinstance(a, ast.TypeExpr):
+                # A bare ident arg parses as an argless TypeExpr; it may name
+                # a constant (const[IPC_STAT]) — probe everything that isn't
+                # a type keyword, locally-defined type, or flag-set name.
+                if not a.args and a.bitfield_len is None \
+                        and a.name not in _TYPE_KEYWORDS:
+                    consts.add(a.name)
+                walk_type(a)
+        if isinstance(te.bitfield_len, ast.Ident):
+            consts.add(te.bitfield_len.name)
+
+    for n in desc.nodes:
+        if isinstance(n, ast.IncludeDef):
+            includes.append(n.path)
+        elif isinstance(n, ast.FlagsDef):
+            for v in n.values:
+                if isinstance(v, ast.Ident):
+                    consts.add(v.name)
+        elif isinstance(n, ast.ResourceDef):
+            walk_type(n.base)
+            for v in n.values:
+                if isinstance(v, ast.Ident):
+                    consts.add(v.name)
+        elif isinstance(n, ast.CallDef):
+            if not n.call_name.startswith("syz_"):
+                calls.add(n.call_name)
+            for f in n.fields:
+                walk_type(f.typ)
+            if n.ret is not None:
+                walk_type(n.ret)
+        elif isinstance(n, ast.StructDef):
+            for f in n.fields:
+                walk_type(f.typ)
+        elif isinstance(n, ast.DefineDef):
+            # define bodies are resolved by the compiler against consts;
+            # pull bare idents out of the expression too.
+            for m in re.finditer(r"(?<![0-9a-zA-Z_])[A-Za-z_][A-Za-z0-9_]*",
+                                 n.expr):
+                consts.add(m.group())
+
+    # Type keywords & flag-set names leak in via bare-ident heuristics
+    # upstream; filter anything that is locally defined in the descriptions.
+    local = set()
+    for n in desc.nodes:
+        if isinstance(n, (ast.FlagsDef, ast.StrFlagsDef, ast.StructDef,
+                          ast.ResourceDef, ast.DefineDef)):
+            local.add(n.name)
+    consts -= local
+    return consts, calls, includes
+
+
+def _probe_source(names: List[str], includes: Iterable[str]) -> str:
+    lines = ["#define _GNU_SOURCE"]
+    for inc in includes:
+        lines.append(f"#include <{inc}>")
+    lines.append("#include <stdio.h>")
+    lines.append("int main(void) {")
+    for n in names:
+        lines.append(
+            f'    printf("{n} %lld\\n", (long long)({n}));')
+    lines.append("    return 0;")
+    lines.append("}")
+    return "\n".join(lines) + "\n"
+
+
+def extract_consts(names: Set[str], includes: List[str],
+                   cc: str = "gcc") -> Tuple[Dict[str, int], Set[str]]:
+    """Compile-and-run probe; returns (values, unresolved)."""
+    remaining = sorted(names)
+    unresolved: Set[str] = set()
+    incs = includes + [i for i in DEFAULT_INCLUDES if i not in includes]
+    with tempfile.TemporaryDirectory() as td:
+        src = Path(td) / "probe.c"
+        binp = Path(td) / "probe"
+        compiled = False
+        last_err = ""
+        for _ in range(len(names) + 2):
+            if not remaining:
+                return {}, unresolved
+            src.write_text(_probe_source(remaining, incs))
+            r = subprocess.run([cc, str(src), "-o", str(binp), "-w"],
+                               capture_output=True, text=True)
+            if r.returncode == 0:
+                compiled = True
+                break
+            last_err = r.stderr
+            bad = _undeclared(r.stderr)
+            # Type names used as values (e.g. a struct name leaking in) fail
+            # with "expected expression before 'name'" instead of undeclared.
+            bad |= set(re.findall(
+                r"expected expression before ['‘]([A-Za-z_][A-Za-z0-9_]*)['’]",
+                r.stderr))
+            bad &= set(remaining)
+            if not bad:
+                raise RuntimeError(
+                    f"const probe failed to compile:\n{r.stderr[:2000]}")
+            unresolved |= bad
+            remaining = [n for n in remaining if n not in bad]
+        if not compiled:
+            raise RuntimeError(
+                "const probe never compiled after pruning; last compiler "
+                f"output:\n{last_err[:2000]}")
+        out = subprocess.run([str(binp)], capture_output=True, text=True,
+                             check=True).stdout
+    vals: Dict[str, int] = {}
+    for line in out.splitlines():
+        name, v = line.rsplit(" ", 1)
+        vals[name] = int(v)
+    return vals, unresolved
+
+
+def extract_for_files(paths: List[Path], cc: str = "gcc"):
+    """Extract consts for description files, each with its own includes."""
+    # Names defined in ANY file (structs/resources/flag-sets/defines) are
+    # description-language symbols, not C constants — filter them globally
+    # so cross-file type references don't leak into the probes.
+    all_desc = parse_files(paths)
+    global_local: Set[str] = set()
+    for n in all_desc.nodes:
+        if isinstance(n, (ast.FlagsDef, ast.StrFlagsDef, ast.StructDef,
+                          ast.ResourceDef, ast.DefineDef)):
+            global_local.add(n.name)
+    merged: Dict[str, int] = {}
+    unresolved: Set[str] = set()
+    for p in paths:
+        desc = parse_files([p])
+        consts, calls, includes = collect_idents(desc)
+        names = (set(consts) - global_local) | {f"__NR_{c}" for c in calls}
+        vals, unres = extract_consts(names, includes, cc=cc)
+        merged.update(vals)
+        unresolved |= unres
+    unresolved -= set(merged)
+    return merged, unresolved
+
+
+def main(argv: List[str]) -> int:
+    arch = "amd64"
+    args = []
+    it = iter(argv)
+    for a in it:
+        if a == "--arch":
+            arch = next(it)
+        else:
+            args.append(a)
+    here = Path(__file__).parent / "linux"
+    paths = [Path(a) for a in args] or sorted(here.glob("*.txt"))
+    out_path = here / f"consts_{arch}.json"
+    existing: Dict[str, int] = {}
+    if out_path.exists():
+        existing = json.loads(out_path.read_text())
+    vals, unresolved = extract_for_files(paths)
+    existing.update(vals)
+    out_path.write_text(json.dumps(existing, indent=1, sort_keys=True) + "\n")
+    print(f"extracted {len(vals)} consts -> {out_path}")
+    if unresolved:
+        print(f"unresolved ({len(unresolved)}): "
+              f"{' '.join(sorted(unresolved))}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -41,13 +41,16 @@ from ..descriptions.tables import (
 from ..prog.tensor import REF_NONE
 from .dtables import DeviceTables
 from .rng import (
-    biased_rand,
-    choose_weighted,
-    pick_masked,
-    rand_int,
-    rand_range_int,
-    rand_u64,
-    sample_flags,
+    RAND_INT_WORDS,
+    RAND_RANGE_WORDS,
+    SAMPLE_FLAGS_WORDS,
+    biased_rand_from,
+    choose_weighted_from,
+    pick_masked_from,
+    rand_int_from,
+    rand_range_int_from,
+    randpool,
+    sample_flags_from,
 )
 
 U64 = jnp.uint64
@@ -77,13 +80,16 @@ def _slot_index_mask(dt: DeviceTables, cid):
 
 def value_mutate(key, dt: DeviceTables, row: Row) -> Row:
     cid, sval, data = row
-    kpick, kop, kd, kb, kr = jax.random.split(key, 5)
+    CS = cid.shape[0] * dt.max_slots
+    # one threefry expansion covers the pick lanes + every scalar sub-draw
+    pool = randpool(key, (), CS + 4 + RAND_RANGE_WORDS + SAMPLE_FLAGS_WORDS)
+    w = pool[CS:]
     sc = _safe(cid)
     kind = dt.slot_kind[sc]
     tk = dt.slot_tkind[sc]
     mutable = _slot_index_mask(dt, cid) & (kind == SK_VALUE) & (
         (tk == TK_INT) | (tk == TK_FLAGS) | (tk == TK_PROC))
-    flat = pick_masked(kpick, mutable.reshape(-1))
+    flat = pick_masked_from(pool[:CS], mutable.reshape(-1))
     ok = flat >= 0
     flat_s = jnp.maximum(flat, 0)
     c, s = flat_s // dt.max_slots, flat_s % dt.max_slots
@@ -94,20 +100,23 @@ def value_mutate(key, dt: DeviceTables, row: Row) -> Row:
     vmask = jnp.where(size >= 8, U64(0xFFFFFFFFFFFFFFFF),
                       (U64(1) << bits) - U64(1))
 
-    delta = (rand_u64(kd) % U64(4)) + U64(1)
-    bit = rand_u64(kb) % bits
+    delta = (w[0] % U64(4)) + U64(1)
+    bit = w[1] % bits
     this_tk = tk[c, s]
     lo, hi = dt.slot_lo[sc][c, s], dt.slot_hi[sc][c, s]
-    resample_int = jnp.where(lo < hi, rand_range_int(kr, lo, hi),
-                             rand_int(kr))
-    resample_flags = sample_flags(kr, dt.slot_flags_off[sc][c, s],
-                                  dt.slot_flags_cnt[sc][c, s], dt.flags_pool)
-    resample_proc = rand_u64(kr) % jnp.maximum(hi, U64(1))
+    rr = w[4:4 + RAND_RANGE_WORDS]
+    resample_int = jnp.where(lo < hi, rand_range_int_from(rr, lo, hi),
+                             rand_int_from(rr[2:2 + RAND_INT_WORDS]))
+    resample_flags = sample_flags_from(
+        w[4 + RAND_RANGE_WORDS:4 + RAND_RANGE_WORDS + SAMPLE_FLAGS_WORDS],
+        dt.slot_flags_off[sc][c, s],
+        dt.slot_flags_cnt[sc][c, s], dt.flags_pool)
+    resample_proc = w[2] % jnp.maximum(hi, U64(1))
     resample = jnp.select(
         [this_tk == TK_FLAGS, this_tk == TK_PROC],
         [resample_flags, resample_proc], resample_int)
 
-    op = jax.random.randint(kop, (), 0, 4)
+    op = (w[3] % U64(4)).astype(jnp.int32)
     nv = jnp.select(
         [op == 0, op == 1, op == 2],
         [cur + delta, cur - delta, cur ^ (U64(1) << bit)],
@@ -122,11 +131,13 @@ def value_mutate(key, dt: DeviceTables, row: Row) -> Row:
 
 def data_mutate(key, dt: DeviceTables, row: Row) -> Row:
     cid, sval, data = row
-    kpick, kop, kpos, kbit, kval, klen = jax.random.split(key, 6)
+    CS = cid.shape[0] * dt.max_slots
+    pool = randpool(key, (), CS + 7 + RAND_INT_WORDS)
+    w = pool[CS:]
     sc = _safe(cid)
     kind = dt.slot_kind[sc]
     mutable = _slot_index_mask(dt, cid) & (kind == SK_DATA)
-    flat = pick_masked(kpick, mutable.reshape(-1))
+    flat = pick_masked_from(pool[:CS], mutable.reshape(-1))
     ok = flat >= 0
     flat_s = jnp.maximum(flat, 0)
     c, s = flat_s // dt.max_slots, flat_s % dt.max_slots
@@ -136,18 +147,18 @@ def data_mutate(key, dt: DeviceTables, row: Row) -> Row:
     lo = dt.slot_lo[sc][c, s].astype(jnp.int32)
     ln = jnp.minimum(sval[c, s].astype(jnp.int32), cap)
 
-    op = jax.random.randint(kop, (), 0, 6)
-    pos = aoff + (jax.random.randint(kpos, (), 0, 1 << 30)
-                  % jnp.maximum(ln, 1))
+    op = (w[0] % U64(6)).astype(jnp.int32)
+    pos = aoff + (w[1] % jnp.maximum(ln, 1).astype(U64)).astype(jnp.int32)
     pos = jnp.clip(pos, 0, dt.arena - 1)
     cur_byte = data[c, pos].astype(jnp.int32)
-    rb = (rand_u64(kval) % U64(256)).astype(jnp.int32)
-    interesting = (rand_int(kval) & U64(0xFF)).astype(jnp.int32)
-    delta = (jax.random.randint(kval, (), -35, 36) | 1)
+    rb = (w[2] % U64(256)).astype(jnp.int32)
+    interesting = (rand_int_from(w[7:7 + RAND_INT_WORDS]) &
+                   U64(0xFF)).astype(jnp.int32)
+    delta = ((w[3] % U64(71)).astype(jnp.int32) - 35) | 1
     new_byte = jnp.select(
         [op == 0, op == 1, op == 2, op == 3],
         [rb,
-         cur_byte ^ (1 << jax.random.randint(kbit, (), 0, 8)),
+         cur_byte ^ (1 << (w[4] % U64(8)).astype(jnp.int32)),
          interesting,
          (cur_byte + delta) & 0xFF],
         cur_byte) & 0xFF
@@ -155,8 +166,8 @@ def data_mutate(key, dt: DeviceTables, row: Row) -> Row:
     data = data.at[c, pos].set(
         jnp.where(byte_ok, new_byte, cur_byte).astype(jnp.uint8))
 
-    grow = jnp.minimum(ln + 1 + jax.random.randint(klen, (), 0, 8), cap)
-    shrink = jnp.maximum(ln - 1 - jax.random.randint(klen, (), 0, 8), lo)
+    grow = jnp.minimum(ln + 1 + (w[5] % U64(8)).astype(jnp.int32), cap)
+    shrink = jnp.maximum(ln - 1 - (w[6] % U64(8)).astype(jnp.int32), lo)
     new_len = jnp.select([op == 4, op == 5], [grow, shrink], ln)
     new_len = jnp.clip(new_len, jnp.minimum(lo, cap), cap)
     sval = sval.at[c, s].set(
@@ -184,7 +195,8 @@ def remove_call(key, dt: DeviceTables, row: Row) -> Row:
     C = cid.shape[0]
     nlive = jnp.sum(_live(cid))
     ok = nlive > 0
-    c = jax.random.randint(key, (), 0, jnp.maximum(nlive, 1))
+    c = (randpool(key, (), 1)[0] %
+         jnp.maximum(nlive, 1).astype(U64)).astype(jnp.int32)
     idxs = jnp.where(jnp.arange(C) >= c, jnp.arange(C) + 1, jnp.arange(C))
     idxs = jnp.minimum(idxs, C - 1)
     new_cid = jnp.where(jnp.arange(C) == C - 1, -1, cid[idxs])
@@ -223,21 +235,22 @@ def _new_call_row(key, dt: DeviceTables, new_id, cid, pos):
 def insert_call(key, dt: DeviceTables, row: Row, pos=None, new_id=None) -> Row:
     cid, sval, data = row
     C = cid.shape[0]
-    kpos, kbias, kpick, kchoose, krow = jax.random.split(key, 5)
+    kw, krow = jax.random.split(key)
+    w = randpool(kw, (), 4)
     nlive = jnp.sum(_live(cid))
     ok = nlive < C
     if pos is None:
-        pos = biased_rand(kpos, nlive + 1, 5)
+        pos = biased_rand_from(w[0], nlive + 1, 5)
     pos = jnp.asarray(pos, jnp.int32)
 
     if new_id is None:
         # bias toward a random existing call's row of the choice table
-        bias_idx = jax.random.randint(kbias, (), 0, jnp.maximum(nlive, 1))
+        bias_idx = (w[1] % jnp.maximum(nlive, 1).astype(U64)).astype(jnp.int32)
         bias_call = cid[jnp.minimum(bias_idx, C - 1)]
         have_bias = (nlive > 0) & (bias_call >= 0)
         row_w = dt.choice_run[_safe(bias_call)]
-        weighted = choose_weighted(kchoose, row_w)
-        uniform = choose_weighted(kpick, dt.enabled_run)
+        weighted = choose_weighted_from(w[2], row_w)
+        uniform = choose_weighted_from(w[3], dt.enabled_run)
         new_id = jnp.where(have_bias & (row_w[-1] > 0), weighted, uniform)
     new_id = jnp.asarray(new_id, jnp.int32)
 
@@ -281,7 +294,8 @@ def splice(key, dt: DeviceTables, row: Row, donor: Row) -> Row:
     # clamp the spliced prefix to the donor's live-call count so the result
     # keeps the contiguous-live-prefix invariant REF decoding relies on
     dlive = jnp.sum(_live(dcid))
-    k = jnp.minimum(1 + jax.random.randint(key, (), 0, C // 2), dlive)
+    k = jnp.minimum(1 + (randpool(key, (), 1)[0] %
+                         U64(max(C // 2, 1))).astype(jnp.int32), dlive)
     ar = jnp.arange(C)
     take_donor = ar < k
     src_own = jnp.maximum(ar - k, 0)
@@ -318,7 +332,7 @@ def mutate_program(key, dt: DeviceTables, row: Row, donor: Row,
         key, kop, kapply = jax.random.split(key, 3)
         # weights ~ reference mix: splice 1, insert 44, value 35, data 10,
         # remove 10 (out of 100)
-        r = jax.random.randint(kop, (), 0, 100)
+        r = (randpool(kop, (), 1)[0] % U64(100)).astype(jnp.int32)
         op = jnp.select([r < 1, r < 45, r < 80, r < 90],
                         [0, 1, 2, 3], 4)
         row = jax.lax.switch(
@@ -361,15 +375,17 @@ def mutate_batch(key, dt: DeviceTables, call_id, slot_val, data,
 
 def _sample_values(key, dt: DeviceTables, ids):
     """Sampled slot values for calls `ids` (any leading shape + [S])."""
-    kv, kf, kp = jax.random.split(key, 3)
     shape = ids.shape + (dt.max_slots,)
+    pool = randpool(key, shape, RAND_RANGE_WORDS + SAMPLE_FLAGS_WORDS + 1)
     tk = dt.slot_tkind[ids]
     lo, hi = dt.slot_lo[ids], dt.slot_hi[ids]
-    ints = jnp.where(lo < hi, rand_range_int(kv, lo, hi, shape),
-                     rand_int(kv, shape))
-    flags = sample_flags(kf, dt.slot_flags_off[ids], dt.slot_flags_cnt[ids],
-                         dt.flags_pool, shape)
-    procs = rand_u64(kp, shape) % jnp.maximum(hi, U64(1))
+    rr = pool[..., :RAND_RANGE_WORDS]
+    ints = jnp.where(lo < hi, rand_range_int_from(rr, lo, hi),
+                     rand_int_from(rr[..., 2:2 + RAND_INT_WORDS]))
+    flags = sample_flags_from(
+        pool[..., RAND_RANGE_WORDS:RAND_RANGE_WORDS + SAMPLE_FLAGS_WORDS],
+        dt.slot_flags_off[ids], dt.slot_flags_cnt[ids], dt.flags_pool)
+    procs = pool[..., -1] % jnp.maximum(hi, U64(1))
     sampled = jnp.select([tk == TK_FLAGS, tk == TK_PROC], [flags, procs],
                          ints)
     size = dt.slot_size[ids]
@@ -389,19 +405,16 @@ def generate_program(key, dt: DeviceTables, C: int, ncalls) -> Row:
     resource refs point at the most recent earlier compatible producer."""
     kid, ku, kv = jax.random.split(key, 3)
 
-    # --- id chain: scan over C ---
-    def id_step(prev_id, ks):
-        k1, k2 = ks
+    # --- id chain: scan over C (pool drawn once outside the scan) ---
+    def id_step(prev_id, w):
         row = dt.choice_run[_safe(prev_id)]
-        weighted = choose_weighted(k1, row)
-        uniform = choose_weighted(k2, dt.enabled_run)  # enabled calls only
+        weighted = choose_weighted_from(w[0], row)
+        uniform = choose_weighted_from(w[1], dt.enabled_run)  # enabled only
         nid = jnp.where((prev_id >= 0) & (row[-1] > 0), weighted,
                         uniform).astype(jnp.int32)
         return nid, nid
 
-    keys = jax.random.split(kid, 2 * C).reshape(C, 2, -1)
-    _, ids = jax.lax.scan(id_step, jnp.int32(-1),
-                          (keys[:, 0], keys[:, 1]))
+    _, ids = jax.lax.scan(id_step, jnp.int32(-1), randpool(kid, (C,), 2))
     ids = jnp.asarray(ids, jnp.int32)
     cid = jnp.where(jnp.arange(C) < ncalls, ids, -1)
     sids = _safe(cid)
@@ -432,7 +445,7 @@ def generate_program(key, dt: DeviceTables, C: int, ncalls) -> Row:
 def generate_rows(key, dt: DeviceTables, *, B: int, C: int):
     """Unjitted batched generation body (shared with parallel/mesh.py)."""
     kn, kg = jax.random.split(key)
-    ncalls = 1 + jax.random.randint(kn, (B,), 0, C)
+    ncalls = 1 + (randpool(kn, (B,), 1)[..., 0] % U64(C)).astype(jnp.int32)
     keys = jax.random.split(kg, B)
     return jax.vmap(lambda k, n: generate_program(k, dt, C, n))(keys, ncalls)
 

@@ -9,6 +9,12 @@ vmap over thousands of program lanes.
 The category chains below reproduce the reference's nested nOutOf(...)
 conditionals as single uniform draws with cumulative thresholds (a chain
 of conditional n/m branches over disjoint remainders is one categorical).
+
+Compile-cost note: every `jax.random.*` call expands a full threefry hash
+into the HLO, which is expensive to codegen (minutes on single-core dev
+hosts).  Each sampler therefore draws ONE pooled `bits` tensor with a
+trailing lane axis and derives all of its sub-draws from pool words with
+cheap arithmetic — one hash per sampler instead of one per draw.
 """
 
 from __future__ import annotations
@@ -28,73 +34,107 @@ SPECIAL_INTS = jnp.array(
     dtype=jnp.uint64,
 )
 
+U64 = jnp.uint64
+
+
+def randpool(key, shape=(), n=1):
+    """One threefry expansion yielding n u64 words per lane: [*shape, n]."""
+    return jax.random.bits(key, tuple(shape) + (n,), dtype=jnp.uint64)
+
+
+def _mod(w, n):
+    """Uniform-ish int in [0, n) from a pool word."""
+    return (w % U64(n)).astype(jnp.int32)
+
+
+def _unit(w):
+    """Uniform float in [0, 1) from a pool word's top 24 bits."""
+    return (w >> U64(40)).astype(jnp.float32) * jnp.float32(2.0 ** -24)
+
 
 def rand_u64(key, shape=()):
     return jax.random.bits(key, shape, dtype=jnp.uint64)
 
 
-def rand_int(key, shape=()):
-    """Magnitude-biased interesting integers (rand.go:69-93)."""
-    k1, k2, k3, k4, k5 = jax.random.split(key, 5)
-    v = rand_u64(k1, shape)
-    cat = jax.random.randint(k2, shape, 0, 182)
-    special = SPECIAL_INTS[jax.random.randint(
-        k3, shape, 0, SPECIAL_INTS.shape[0])]
+def rand_int_from(pool):
+    """Magnitude-biased interesting integers (rand.go:69-93) from a
+    >=5-word pool slice [..., 5]."""
+    v = pool[..., 0]
+    cat = _mod(pool[..., 1], 182)
+    special = SPECIAL_INTS[_mod(pool[..., 2], SPECIAL_INTS.shape[0])]
     v = jnp.select(
         [cat < 100, cat < 150, cat < 160, cat < 170, cat < 180],
-        [v % 10, special, v % 256, v % (4 << 10), v % (64 << 10)],
-        v % (1 << 31),
+        [v % U64(10), special, v % U64(256), v % U64(4 << 10),
+         v % U64(64 << 10)],
+        v % U64(1 << 31),
     )
-    cat2 = jax.random.randint(k4, shape, 0, 107)
-    shift = jax.random.randint(k5, shape, 0, 63).astype(jnp.uint64)
-    v = jnp.select(
+    cat2 = _mod(pool[..., 3], 107)
+    shift = _mod(pool[..., 4], 63).astype(U64)
+    return jnp.select(
         [cat2 < 100, cat2 < 105],
-        [v, (-v.astype(jnp.int64)).astype(jnp.uint64)],
+        [v, (-v.astype(jnp.int64)).astype(U64)],
         v << shift,
     )
-    return v
+
+
+RAND_INT_WORDS = 5
+
+
+def rand_int(key, shape=()):
+    return rand_int_from(randpool(key, shape, RAND_INT_WORDS))
+
+
+def rand_range_int_from(pool, lo, hi):
+    """Uniform in [lo, hi] with a 1/100 escape to rand_int (rand.go:95-100)
+    from a >=7-word pool slice."""
+    lo = jnp.asarray(lo, U64)
+    hi = jnp.asarray(hi, U64)
+    raw = pool[..., 0]
+    span = hi - lo + U64(1)  # wraps to 0 for the full u64 range
+    u = jnp.where(span == U64(0), raw, raw % jnp.maximum(span, U64(1)) + lo)
+    esc = _mod(pool[..., 1], 100) == 0
+    return jnp.where(esc, rand_int_from(pool[..., 2:7]), u)
+
+
+RAND_RANGE_WORDS = 7
 
 
 def rand_range_int(key, lo, hi, shape=()):
-    """Uniform in [lo, hi] with a 1/100 escape to rand_int (rand.go:95-100)."""
-    k1, k2, k3 = jax.random.split(key, 3)
-    lo = jnp.asarray(lo, jnp.uint64)
-    hi = jnp.asarray(hi, jnp.uint64)
-    raw = rand_u64(k1, shape)
-    span = hi - lo + 1  # wraps to 0 for the full u64 range
-    u = jnp.where(span == 0, raw, raw % jnp.maximum(span, 1) + lo)
-    esc = jax.random.randint(k2, shape, 0, 100) == 0
-    return jnp.where(esc, rand_int(k3, shape), u)
+    return rand_range_int_from(randpool(key, shape, RAND_RANGE_WORDS), lo, hi)
 
 
-def biased_rand(key, n, k, shape=()):
+def biased_rand_from(word, n, k):
     """Quadratic bias toward n-1: P(n-1) = k * P(0) (rand.go:104-109)."""
     nf = jnp.asarray(n, jnp.float32)
     kf = jnp.asarray(k, jnp.float32)
-    rf = nf * (kf / 2 + 1) * jax.random.uniform(key, shape)
+    rf = nf * (kf / 2 + 1) * _unit(word)
     bf = (-1 + jnp.sqrt(1 + 2 * kf * rf / nf)) * nf / kf
     return jnp.clip(bf.astype(jnp.int32), 0, jnp.asarray(n, jnp.int32) - 1)
 
 
-def sample_flags(key, flags_off, flags_cnt, pool, shape=()):
-    """Flag-combination sampler (rand.go:140-154): usually OR of a geometric
-    number of set members, sometimes a single member, zero, or garbage.
+def biased_rand(key, n, k, shape=()):
+    return biased_rand_from(randpool(key, shape, 1)[..., 0], n, k)
 
-    flags_off/flags_cnt may be arrays broadcastable to `shape` (each lane can
-    sample from a different flag set out of the shared pool)."""
-    k1, k2, k3, k4 = jax.random.split(key, 4)
-    cnt = jnp.maximum(jnp.asarray(flags_cnt), 1)
+
+def sample_flags_from(pool, flags_off, flags_cnt, flag_pool):
+    """Flag-combination sampler (rand.go:140-154) from a >=10-word pool
+    slice: usually OR of a geometric number of set members, sometimes a
+    single member, zero, or garbage.
+
+    flags_off/flags_cnt may be arrays broadcastable to the pool's leading
+    shape (each lane samples from its own flag set in the shared pool)."""
+    cnt = jnp.maximum(jnp.asarray(flags_cnt), 1).astype(U64)
     off = jnp.asarray(flags_off)
     # 4 candidate members; member j included with prob 2^-j (geometric OR)
-    idx = jax.random.randint(k2, shape + (4,), 0, 1 << 30) % cnt[..., None]
-    vals = pool[off[..., None] + idx]
-    include = jax.random.uniform(k3, shape + (4,)) < jnp.array(
-        [1.0, 0.5, 0.25, 0.125])
-    ored = jnp.where(include, vals, 0).reshape(shape + (4,))
+    idx = (pool[..., 0:4] % cnt[..., None]).astype(jnp.int32)
+    vals = flag_pool[off[..., None] + idx]
+    thresh = jnp.array([256, 128, 64, 32], dtype=U64)
+    include = (pool[..., 4:8] & U64(0xFF)) < thresh
+    ored = jnp.where(include, vals, U64(0))
     ored = jnp.bitwise_or.reduce(ored, axis=-1)
     single = vals[..., 0]
-    cat = jax.random.randint(k1, shape, 0, 111)
-    garbage = rand_u64(k4, shape)
+    cat = _mod(pool[..., 8], 111)
+    garbage = pool[..., 9]
     return jnp.select(
         [cat < 90, cat < 100, cat < 110],
         [ored, single, jnp.zeros_like(garbage)],
@@ -102,18 +142,34 @@ def sample_flags(key, flags_off, flags_cnt, pool, shape=()):
     )
 
 
-def choose_weighted(key, cumsum_row):
+SAMPLE_FLAGS_WORDS = 10
+
+
+def sample_flags(key, flags_off, flags_cnt, pool, shape=()):
+    return sample_flags_from(randpool(key, shape, SAMPLE_FLAGS_WORDS),
+                             flags_off, flags_cnt, pool)
+
+
+def choose_weighted_from(word, cumsum_row):
     """Sample an index from an int cumulative-weight row (prio.go:231-247:
     uniform in [0, total) then binary search)."""
-    total = cumsum_row[-1]
-    x = jax.random.randint(key, (), 0, jnp.maximum(total, 1),
-                           dtype=cumsum_row.dtype)
+    total = jnp.maximum(cumsum_row[-1], 1).astype(U64)
+    x = (word % total).astype(cumsum_row.dtype)
     return jnp.searchsorted(cumsum_row, x, side="right").astype(jnp.int32)
 
 
+def choose_weighted(key, cumsum_row):
+    return choose_weighted_from(randpool(key, (), 1)[..., 0], cumsum_row)
+
+
+def pick_masked_from(pool, mask):
+    """Uniformly pick an index where mask is true (-1 if none) from a pool
+    [..., mask.shape[-1]] of u64 words."""
+    score = jnp.where(mask, pool, U64(0))
+    idx = jnp.argmax(score, axis=-1)
+    return jnp.where(jnp.any(mask, axis=-1), idx.astype(jnp.int32), -1)
+
+
 def pick_masked(key, mask):
-    """Uniformly pick an index where mask is true (-1 if none)."""
-    u = jax.random.uniform(key, mask.shape)
-    score = jnp.where(mask, u, -1.0)
-    idx = jnp.argmax(score)
-    return jnp.where(jnp.any(mask), idx.astype(jnp.int32), -1)
+    return pick_masked_from(randpool(key, mask.shape[:-1],
+                                     mask.shape[-1]), mask)

@@ -408,26 +408,50 @@ def decode_prog(tables: CompiledTables, fmt: TensorFormat,
 
 
 def _find_source(call: Call, res_type, target) -> Optional[Arg]:
-    """A resource source inside `call` compatible with res_type."""
+    """A resource source inside `call` compatible with res_type.
+
+    Falls back to root-kind compatibility (kind[0] match) when no
+    prefix-compatible source exists: generation's rare cross-kind resource
+    reuse (create_resource's 1/1000 any-kind path, mirroring the
+    reference's prog/rand.go resourceCentric trick) produces such refs, and
+    decode must accept whatever encode preserved."""
     want = res_type.desc.name
+    root = res_type.desc.kind[0]
+
+    def ok(desc) -> int:
+        if target.is_compatible_resource(want, desc.name):
+            return 2
+        return 1 if desc.kind[0] == root else 0
+
+    best: Optional[Arg] = None
+    best_rank = 0
     if call.ret is not None and isinstance(call.ret.typ, ResourceType):
-        if target.is_compatible_resource(want, call.ret.typ.desc.name):
+        best_rank = ok(call.ret.typ.desc)
+        if best_rank == 2:
             return call.ret
+        best = call.ret if best_rank else None
+
     found: List[Arg] = []
 
     from .prog import foreach_subarg
 
     def chk(a: Arg, _b):
+        nonlocal best, best_rank
         if found:
             return
         if isinstance(a, ResultArg) and isinstance(a.typ, ResourceType) \
-                and a.typ.dir != Dir.IN \
-                and target.is_compatible_resource(want, a.typ.desc.name):
-            found.append(a)
+                and a.typ.dir != Dir.IN:
+            rank = ok(a.typ.desc)
+            if rank == 2:
+                found.append(a)
+            elif rank > best_rank:
+                best, best_rank = a, rank
 
     for a in call.args:
         foreach_subarg(a, chk)
-    return found[0] if found else None
+    if found:
+        return found[0]
+    return best
 
 
 def encode_batch(tables: CompiledTables, fmt: TensorFormat,

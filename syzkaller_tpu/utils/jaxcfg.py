@@ -18,4 +18,28 @@ def ensure_x64() -> None:
     import jax
 
     jax.config.update("jax_enable_x64", True)
+    _enable_compile_cache(jax)
     _done = True
+
+
+def _enable_compile_cache(jax) -> None:
+    """Persistent XLA compilation cache.
+
+    Dev/CI hosts for this project can be single-core (the axon TPU tunnel
+    box), where LLVM codegen of the u64-heavy kernels costs minutes; the
+    disk cache makes every compile after the first instant.  Opt out with
+    SYZ_TPU_NO_COMPILE_CACHE=1."""
+    import os
+
+    if os.environ.get("SYZ_TPU_NO_COMPILE_CACHE"):
+        return
+    cache = os.environ.get("SYZ_TPU_COMPILE_CACHE") or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), ".jax_cache")
+    try:
+        os.makedirs(cache, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:  # cache is an optimization, never a requirement
+        pass

@@ -124,9 +124,10 @@ def test_mutate_respects_ncalls(target, ct):
         for step in range(5):
             mutate(p, RandGen(target, seed=seed * 100 + step), 10, ct, corpus)
         p.validate()
-        # ncalls is a soft cap (ctor-sequence insertion can overshoot, as in
-        # the reference); it must stay bounded
-        assert len(p.calls) <= 2 * 10
+        # ncalls is a soft cap (ctor-sequence insertion and mmap synthesis
+        # for pointer args can overshoot, as in the reference); it must stay
+        # bounded
+        assert len(p.calls) <= 4 * 10
 
 
 def test_deterministic_generation(target, ct):
