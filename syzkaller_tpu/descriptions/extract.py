@@ -222,22 +222,41 @@ def extract_for_files(paths: List[Path], cc: str = "gcc"):
     return merged, unresolved
 
 
+_HOST_ARCH = {"x86_64": "amd64", "aarch64": "arm64", "i686": "386",
+              "i386": "386", "ppc64le": "ppc64le", "riscv64": "riscv64"}
+
+
 def main(argv: List[str]) -> int:
     arch = "amd64"
+    cc = None
     args = []
     it = iter(argv)
     for a in it:
         if a == "--arch":
             arch = next(it)
+        elif a == "--cc":
+            cc = next(it)
         else:
             args.append(a)
+    import platform
+
+    host = _HOST_ARCH.get(platform.machine(), platform.machine())
+    if cc is None:
+        if arch != host:
+            # host headers would silently yield host-arch values (wrong
+            # __NR_* numbers etc.) — demand an explicit cross compiler,
+            # like the reference's per-arch CC matrix (sys/targets)
+            print(f"--arch {arch} != host arch {host}: pass --cc "
+                  f"<cross-gcc> targeting {arch}", file=sys.stderr)
+            return 1
+        cc = "gcc"
     here = Path(__file__).parent / "linux"
     paths = [Path(a) for a in args] or sorted(here.glob("*.txt"))
     out_path = here / f"consts_{arch}.json"
     existing: Dict[str, int] = {}
     if out_path.exists():
         existing = json.loads(out_path.read_text())
-    vals, unresolved = extract_for_files(paths)
+    vals, unresolved = extract_for_files(paths, cc=cc)
     existing.update(vals)
     out_path.write_text(json.dumps(existing, indent=1, sort_keys=True) + "\n")
     print(f"extracted {len(vals)} consts -> {out_path}")

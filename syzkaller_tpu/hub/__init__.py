@@ -1,0 +1,405 @@
+"""Hub: cross-manager corpus + reproducer exchange.
+
+Role parity with the reference's syz-hub (reference: /root/reference/
+syz-hub/hub.go:68-117 Connect/Sync RPC; syz-hub/state/state.go:54-356
+per-manager on-disk state with delta-sync sequence numbers, call-set
+filtering, More backpressure, and corpus purge).  Differences from the
+reference are deliberate: programs travel as text (JSON frames over the
+same RPC layer the manager<->fuzzer protocol uses), and per-record
+sequence numbers live in one JSON index per database instead of inside
+the db records.
+
+In the TPU deployment picture this is the DCN tier: within a pod, signal
+bitsets union over ICI collectives (parallel/collective.py); across pods
+and between independent manager hosts, corpus deltas flow through a hub
+exactly like the reference's multi-manager federation (SURVEY.md §2.6).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..db import DB
+from ..manager.rpc import RpcClient, RpcServer
+from ..prog.encoding import call_set
+from ..utils.hash import hash_str
+
+MAX_SYNC_RECORDS = 1000  # More-backpressure threshold (state.go:292)
+
+
+class AuthError(RuntimeError):
+    pass
+
+
+class _SeqDB:
+    """A corpus DB plus a persisted sig->seq index (the reference embeds
+    seq in db records; we keep a sidecar JSON)."""
+
+    def __init__(self, path: str):
+        self.db = DB.open(path)
+        self.seq_path = path + ".seq"
+        self.seqs: Dict[str, int] = {}
+        if os.path.exists(self.seq_path):
+            try:
+                self.seqs = {k: int(v) for k, v in json.loads(
+                    open(self.seq_path).read()).items()}
+            except (ValueError, OSError):
+                self.seqs = {}
+        # drop seq entries for records that no longer exist; records whose
+        # sidecar entry was lost (crash between db flush and seq replace)
+        # get max_seq+1 so `cursor >= seq` filters still deliver them
+        have = {k.decode() for k, _ in self.db.items()}
+        self.seqs = {k: v for k, v in self.seqs.items() if k in have}
+        recovered = have - self.seqs.keys()
+        if recovered:
+            seq = max(self.seqs.values(), default=0) + 1
+            for k in recovered:
+                self.seqs[k] = seq
+
+    @property
+    def max_seq(self) -> int:
+        return max(self.seqs.values(), default=0)
+
+    def save(self, sig: str, value: bytes, seq: int) -> None:
+        self.db.save(sig.encode(), value)
+        self.seqs[sig] = seq
+
+    def delete(self, sig: str) -> None:
+        self.db.delete(sig.encode())
+        self.seqs.pop(sig, None)
+
+    def __contains__(self, sig: str) -> bool:
+        return sig.encode() in self.db
+
+    def get(self, sig: str) -> Optional[bytes]:
+        return self.db.get(sig.encode())
+
+    def sigs(self) -> List[str]:
+        return list(self.seqs)
+
+    def flush(self) -> None:
+        self.db.flush()
+        tmp = self.seq_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self.seqs, f)
+        os.replace(tmp, self.seq_path)
+
+    def close(self) -> None:
+        self.db.close()
+
+
+class _HubManager:
+    """Per-manager hub-side state (state.go:34-50)."""
+
+    def __init__(self, dir_: str, name: str):
+        self.name = name
+        self.dir = dir_
+        os.makedirs(dir_, exist_ok=True)
+        self.corpus = _SeqDB(os.path.join(dir_, "corpus.db"))
+        self.seq_file = os.path.join(dir_, "seq")
+        self.repro_seq_file = os.path.join(dir_, "repro.seq")
+        self.corpus_seq = _load_seq(self.seq_file)
+        self.repro_seq = _load_seq(self.repro_seq_file)
+        self.calls: Set[str] = set()
+        self.own_repros: Set[str] = set()
+        self.connected = 0.0
+        # running totals for the hub status page / tests
+        self.added = self.deleted = self.new = 0
+        self.sent_repros = self.recv_repros = 0
+
+    def save_seqs(self) -> None:
+        _save_seq(self.seq_file, self.corpus_seq)
+        _save_seq(self.repro_seq_file, self.repro_seq)
+
+
+def _load_seq(path: str) -> int:
+    try:
+        return int(open(path).read().strip())
+    except (OSError, ValueError):
+        return 0
+
+
+def _save_seq(path: str, seq: int) -> None:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(seq))
+    os.replace(tmp, path)
+
+
+class HubState:
+    """All hub state, persisted under `dir` (state.go:54-139)."""
+
+    def __init__(self, dir_: str):
+        self.dir = dir_
+        os.makedirs(dir_, exist_ok=True)
+        self.corpus = _SeqDB(os.path.join(dir_, "corpus.db"))
+        self.repros = _SeqDB(os.path.join(dir_, "repro.db"))
+        self.corpus_seq = self.corpus.max_seq
+        self.repro_seq = self.repros.max_seq
+        self.managers: Dict[str, _HubManager] = {}
+        mdir = os.path.join(dir_, "manager")
+        os.makedirs(mdir, exist_ok=True)
+        for name in sorted(os.listdir(mdir)):
+            self.managers[name] = _HubManager(os.path.join(mdir, name), name)
+        self.purge_corpus()
+
+    # ---- lifecycle ----
+
+    def _manager(self, name: str) -> _HubManager:
+        if name not in self.managers:
+            self.managers[name] = _HubManager(
+                os.path.join(self.dir, "manager", name), name)
+        return self.managers[name]
+
+    def connect(self, name: str, fresh: bool, calls: Sequence[str],
+                corpus: Sequence[str]) -> None:
+        """(Re)register a manager; `fresh` resets its delta cursor so it
+        receives the whole hub corpus again (state.go:141-173)."""
+        mgr = self._manager(name)
+        mgr.connected = time.time()
+        if fresh:
+            mgr.corpus_seq = 0
+            mgr.repro_seq = 0
+        mgr.save_seqs()
+        mgr.calls = set(calls)
+        # reset the manager's mirrored corpus to exactly what it declared
+        mgr.corpus.close()
+        for suffix in ("", ".seq"):
+            p = os.path.join(mgr.dir, "corpus.db" + suffix)
+            if os.path.exists(p):
+                os.remove(p)
+        mgr.corpus = _SeqDB(os.path.join(mgr.dir, "corpus.db"))
+        self._add_inputs(mgr, corpus)
+        self.purge_corpus()
+
+    def sync(self, name: str, add: Sequence[str], del_: Sequence[str]
+             ) -> Tuple[List[str], int]:
+        """One delta exchange; returns (progs_for_manager, more_pending)
+        (state.go:175-196)."""
+        mgr = self.managers.get(name)
+        if mgr is None or not mgr.connected:
+            raise RuntimeError(f"unconnected manager {name!r}")
+        if del_:
+            for sig in del_:
+                mgr.corpus.delete(sig)
+            mgr.corpus.flush()
+            self.purge_corpus()
+        self._add_inputs(mgr, add)
+        progs, more = self._pending_inputs(mgr)
+        mgr.added += len(add)
+        mgr.deleted += len(del_)
+        mgr.new += len(progs)
+        return progs, more
+
+    # ---- repro exchange (state.go:197-264) ----
+
+    def add_repro(self, name: str, repro: str) -> None:
+        mgr = self.managers.get(name)
+        if mgr is None or not mgr.connected:
+            raise RuntimeError(f"unconnected manager {name!r}")
+        if not call_set(repro):
+            return
+        sig = hash_str(repro.encode())
+        if sig in self.repros:
+            return
+        mgr.own_repros.add(sig)
+        mgr.sent_repros += 1
+        if mgr.repro_seq == self.repro_seq:
+            mgr.repro_seq += 1
+            _save_seq(mgr.repro_seq_file, mgr.repro_seq)
+        self.repro_seq += 1
+        self.repros.save(sig, repro.encode(), self.repro_seq)
+        self.repros.flush()
+
+    def pending_repro(self, name: str) -> Optional[str]:
+        mgr = self.managers.get(name)
+        if mgr is None or not mgr.connected:
+            raise RuntimeError(f"unconnected manager {name!r}")
+        if mgr.repro_seq == self.repro_seq:
+            return None
+        best_sig, best_seq = None, None
+        for sig, seq in self.repros.seqs.items():
+            if mgr.repro_seq >= seq or sig in mgr.own_repros:
+                continue
+            val = self.repros.get(sig)
+            if val is None:
+                continue
+            if not mgr.calls.issuperset(call_set(val.decode())):
+                continue
+            if best_seq is None or seq < best_seq:
+                best_sig, best_seq = sig, seq
+        if best_sig is None:
+            mgr.repro_seq = self.repro_seq
+            _save_seq(mgr.repro_seq_file, mgr.repro_seq)
+            return None
+        mgr.recv_repros += 1
+        mgr.repro_seq = best_seq
+        _save_seq(mgr.repro_seq_file, mgr.repro_seq)
+        return self.repros.get(best_sig).decode()
+
+    # ---- internals ----
+
+    def _add_inputs(self, mgr: _HubManager, inputs: Sequence[str]) -> None:
+        if not inputs:
+            return
+        self.corpus_seq += 1
+        for text in inputs:
+            if not call_set(text):
+                continue
+            sig = hash_str(text.encode())
+            mgr.corpus.save(sig, b"", 0)
+            if sig not in self.corpus:
+                self.corpus.save(sig, text.encode(), self.corpus_seq)
+        mgr.corpus.flush()
+        self.corpus.flush()
+
+    def _pending_inputs(self, mgr: _HubManager) -> Tuple[List[str], int]:
+        """Deltas since the manager's cursor, call-filtered, capped at
+        MAX_SYNC_RECORDS with a More count (state.go:265-309)."""
+        if mgr.corpus_seq == self.corpus_seq:
+            return [], 0
+        records: List[Tuple[int, str, str]] = []  # (seq, sig, text)
+        for sig, seq in self.corpus.seqs.items():
+            if mgr.corpus_seq >= seq or sig in mgr.corpus:
+                continue
+            val = self.corpus.get(sig)
+            if val is None:
+                continue
+            text = val.decode()
+            if not mgr.calls.issuperset(call_set(text)):
+                continue
+            records.append((seq, sig, text))
+        max_seq = self.corpus_seq
+        more = 0
+        if len(records) > MAX_SYNC_RECORDS:
+            records.sort()
+            pos = MAX_SYNC_RECORDS
+            max_seq = records[pos][0]
+            # round up to a whole seq group so the cursor stays consistent
+            while pos + 1 < len(records) and records[pos + 1][0] == max_seq:
+                pos += 1
+            pos += 1
+            more = len(records) - pos
+            records = records[:pos]
+        mgr.corpus_seq = max_seq
+        _save_seq(mgr.seq_file, mgr.corpus_seq)
+        return [text for _, _, text in records], more
+
+    def purge_corpus(self) -> None:
+        """Drop hub-corpus records no connected manager mirrors
+        (state.go:338-354)."""
+        used: Set[str] = set()
+        for mgr in self.managers.values():
+            used.update(mgr.corpus.sigs())
+        for sig in list(self.corpus.sigs()):
+            if sig not in used:
+                self.corpus.delete(sig)
+        self.corpus.flush()
+
+    def close(self) -> None:
+        self.corpus.close()
+        self.repros.close()
+        for mgr in self.managers.values():
+            mgr.corpus.close()
+
+
+@dataclass
+class HubConfig:
+    workdir: str
+    rpc: str = "127.0.0.1:0"
+    clients: Dict[str, str] = field(default_factory=dict)  # name -> key
+
+
+class Hub:
+    """The hub service: auth + locking around HubState, exposed over the
+    shared RPC layer (hub.go:31-124)."""
+
+    def __init__(self, cfg: HubConfig):
+        self.cfg = cfg
+        self.state = HubState(cfg.workdir)
+        self.lock = threading.Lock()
+        host, port = cfg.rpc.rsplit(":", 1)
+        self._server = RpcServer(_HubHandler(self), host, int(port))
+        self.addr = self._server.addr
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self) -> None:
+        self._server.stop()
+        with self.lock:
+            self.state.close()
+
+    def auth(self, client: str, key: str, manager: str) -> str:
+        want = self.cfg.clients.get(client)
+        if want is None or want != key:
+            raise AuthError(f"unauthorized client {client!r}")
+        name = client
+        if manager:
+            # sub-managers: "client-manager", like the reference's
+            # client/manager split (hub.go:118-124)
+            name = f"{client}-{manager}" if not manager.startswith(client) \
+                else manager
+        return name
+
+
+class _HubHandler:
+    """RPC surface; method names mirror HubConnectArgs/HubSyncArgs
+    (rpctype.go:65-102)."""
+
+    def __init__(self, hub: Hub):
+        self._hub = hub
+
+    def hub_connect(self, client: str, key: str, manager: str = "",
+                    fresh: bool = False, calls: Sequence[str] = (),
+                    corpus: Sequence[str] = ()):
+        name = self._hub.auth(client, key, manager)
+        with self._hub.lock:
+            self._hub.state.connect(name, fresh, calls, corpus)
+        return {}
+
+    def hub_sync(self, client: str, key: str, manager: str = "",
+                 need_repros: bool = False, repros: Sequence[str] = (),
+                 add: Sequence[str] = (), **kw):
+        name = self._hub.auth(client, key, manager)
+        del_ = kw.get("del", kw.get("del_", []))
+        with self._hub.lock:
+            st = self._hub.state
+            progs, more = st.sync(name, add, del_)
+            for repro in repros:
+                st.add_repro(name, repro)
+            out_repros: List[str] = []
+            if need_repros:
+                r = st.pending_repro(name)
+                if r is not None:
+                    out_repros.append(r)
+        return {"progs": progs, "more": more, "repros": out_repros}
+
+
+class HubClient:
+    """Manager-side connection to a hub (the manager's hubSync loop uses
+    this; reference: syz-manager/manager.go:994-...)."""
+
+    def __init__(self, addr: str, client: str, key: str, manager: str = ""):
+        self._rpc = RpcClient(addr)
+        self._ident = {"client": client, "key": key, "manager": manager}
+
+    def connect(self, fresh: bool, calls: Sequence[str],
+                corpus: Sequence[str]) -> None:
+        self._rpc.call("hub_connect", fresh=fresh, calls=list(calls),
+                       corpus=list(corpus), **self._ident)
+
+    def sync(self, add: Sequence[str] = (), del_: Sequence[str] = (),
+             repros: Sequence[str] = (), need_repros: bool = False):
+        r = self._rpc.call("hub_sync", add=list(add),
+                           repros=list(repros), need_repros=need_repros,
+                           **{**self._ident, "del": list(del_)})
+        return r["progs"], r["more"], r["repros"]
+
+    def close(self) -> None:
+        self._rpc.close()

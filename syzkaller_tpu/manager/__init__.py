@@ -50,6 +50,7 @@ class ManagerConfig:
     bench_file: str = ""
     hub_addr: str = ""
     hub_key: str = ""
+    kernel_obj: str = ""  # vmlinux path for the /cover symbolized report
     ignores: List[str] = field(default_factory=list)
     suppressions: List[str] = field(default_factory=list)
     vm: VMConfig = field(default_factory=VMConfig)
@@ -87,6 +88,7 @@ class Manager:
         self.connected_fuzzers: Set[str] = set()
         self.crashes: Dict[str, CrashEntry] = {}
         self.max_signal: Set[int] = set()
+        self.max_cover: Set[int] = set()  # union of per-call cover PCs
         # corpus: hash -> (prog text, signal)
         self.corpus: Dict[str, str] = {}
         self.corpus_signal: Dict[str, List[int]] = {}
@@ -103,12 +105,29 @@ class Manager:
 
         self.rpc = RpcServer(_RpcHandler(self), *self._split(cfg.rpc))
         self.rpc.start()
+        self.http = None
+        if cfg.http:
+            from .html import ManagerHttp
+
+            self.http = ManagerHttp(self, *self._split(cfg.http))
+            self.http.start()
         self._bench_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         if cfg.bench_file:
             self._bench_thread = threading.Thread(
                 target=self._bench_loop, daemon=True)
             self._bench_thread.start()
+
+        # hub federation (reference manager.go:303-310, 994-...)
+        self._hub = None
+        self._hub_connected = False
+        self._hub_ever_connected = False
+        self._hub_synced: Set[str] = set()  # sigs already mirrored to hub
+        self._hub_thread: Optional[threading.Thread] = None
+        if cfg.hub_addr:
+            self._hub_thread = threading.Thread(
+                target=self._hub_loop, daemon=True)
+            self._hub_thread.start()
 
     @staticmethod
     def _split(addr: str):
@@ -209,6 +228,9 @@ class Manager:
     def on_new_input(self, name: str, prog_text: str, call_index: int,
                      signal: Sequence[int], cover: Sequence[int]):
         self._bump("manager_new_inputs")
+        if cover:
+            with self._lock:
+                self.max_cover.update(cover)
         if self._add_corpus(prog_text, signal):
             with self._lock:
                 # fan the input out to every other connected fuzzer
@@ -293,6 +315,81 @@ class Manager:
                 **self.stats,
             }
 
+    # ---- hub sync (reference manager.go:994-...; syz-hub/hub.go) ----
+
+    def hub_sync_once(self) -> int:
+        """One corpus-delta exchange with the hub (draining `more` pages in
+        the same call, like the reference's while-More loop); received
+        programs are injected as candidates.  Returns number of programs
+        received.  Runs from the hub thread; callable directly in tests."""
+        from ..hub import HubClient
+
+        if self._hub is None:
+            self._hub = HubClient(self.cfg.hub_addr, self.cfg.name,
+                                  self.cfg.hub_key)
+        if not self._hub_connected:
+            with self._lock:
+                corpus = list(self.corpus.values())
+                sigs = set(self.corpus)
+            # fresh only on the first connect of this manager's lifetime:
+            # reconnects after transient errors keep the hub-side cursor,
+            # so the delta stream resumes instead of restarting
+            self._hub.connect(
+                fresh=not self._hub_ever_connected,
+                calls=[s.name for s in self.target.syscalls],
+                corpus=corpus)
+            self._hub_connected = True
+            self._hub_ever_connected = True
+            self._hub_synced = sigs
+            self._bump("hub_send", len(corpus))
+            if self.phase == PHASE_TRIAGED_CORPUS:
+                self.phase = PHASE_QUERIED_HUB
+        with self._lock:
+            cur = dict(self.corpus)
+        add = [cur[h] for h in cur.keys() - self._hub_synced]
+        del_ = sorted(self._hub_synced - cur.keys())
+        accepted = 0
+        more = 1
+        while more:
+            progs, more, _repros = self._hub.sync(add=add, del_=del_)
+            self._bump("hub_send", len(add))
+            self._hub_synced = set(cur)
+            add, del_ = [], []  # later pages only drain pending deltas
+            for text in progs:
+                try:
+                    deserialize(self.target, text)
+                except Exception:
+                    continue
+                with self._lock:
+                    self.candidates.append(text)
+                accepted += 1
+        self._bump("hub_recv", accepted)
+        if accepted and self.phase == PHASE_QUERIED_HUB:
+            self.phase = PHASE_TRIAGED_HUB
+        return accepted
+
+    def _hub_loop(self) -> None:
+        """Every minute once the initial corpus is triaged (reference
+        hubSync cadence, manager.go:303-310)."""
+        from ..utils import log
+
+        while not self._stop.wait(60.0):
+            if self.phase < PHASE_TRIAGED_CORPUS:
+                continue
+            try:
+                self.hub_sync_once()
+            except Exception as e:
+                # hub unreachable: drop the connection, retry next tick
+                log.logf(0, "hub sync failed: %s: %s", type(e).__name__, e)
+                self._bump("hub_errors")
+                if self._hub is not None:
+                    try:
+                        self._hub.close()
+                    except Exception:
+                        pass
+                self._hub = None
+                self._hub_connected = False
+
     def _bench_loop(self) -> None:
         """Minute-resolution JSON lines (reference -bench manager.go:
         267-301; rendered by tools/benchcmp.py)."""
@@ -304,6 +401,13 @@ class Manager:
     def close(self) -> None:
         self._stop.set()
         self.rpc.stop()
+        if self.http is not None:
+            self.http.stop()
+        if self._hub is not None:
+            try:
+                self._hub.close()
+            except Exception:
+                pass
         self.db.close()
 
 

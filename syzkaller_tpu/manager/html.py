@@ -1,0 +1,240 @@
+"""Manager HTTP UI: summary, corpus, crashes, coverage, priorities.
+
+Role parity with reference /root/reference/syz-manager/html.go:30-39
+(endpoint set) and syz-manager/cover.go:52-110 (coverage report).  The
+reference's report objdumps vmlinux for all coverable PCs; here the
+report is built from the PCs the fleet actually covered — symbolized to
+func/file:line when a kernel object is configured (report/symbolize.py
+wraps addr2line/nm), raw PC tables otherwise.
+"""
+
+from __future__ import annotations
+
+import html as _html
+import http.server
+import json
+import os
+import threading
+import urllib.parse
+from typing import Dict, List, Optional
+
+from ..prog.encoding import call_set
+
+_STYLE = """
+<style>
+body { font-family: monospace; margin: 1em 2em; }
+table { border-collapse: collapse; }
+td, th { border: 1px solid #999; padding: 2px 8px; text-align: left; }
+th { background: #eee; }
+a { text-decoration: none; }
+h1 { font-size: 1.3em; }
+</style>
+"""
+
+
+def _page(title: str, body: str) -> bytes:
+    return (f"<html><head><title>{_html.escape(title)}</title>{_STYLE}"
+            f"</head><body><h1>{_html.escape(title)}</h1>{body}"
+            f"</body></html>").encode()
+
+
+def _table(headers: List[str], rows: List[List[str]],
+           raw: bool = False) -> str:
+    esc = (lambda s: s) if raw else (lambda s: _html.escape(str(s)))
+    out = ["<table><tr>"]
+    out += [f"<th>{_html.escape(h)}</th>" for h in headers]
+    out.append("</tr>")
+    for r in rows:
+        out.append("<tr>" + "".join(f"<td>{esc(c)}</td>" for c in r)
+                   + "</tr>")
+    out.append("</table>")
+    return "".join(out)
+
+
+class ManagerHttp:
+    """Serves the UI for a live Manager on cfg.http (ephemeral port ok)."""
+
+    def __init__(self, mgr, host: str = "127.0.0.1", port: int = 0):
+        self.mgr = mgr
+        ui = self
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):  # silenced: the manager has own logs
+                pass
+
+            def do_GET(self) -> None:
+                try:
+                    url = urllib.parse.urlparse(self.path)
+                    q = dict(urllib.parse.parse_qsl(url.query))
+                    route = {
+                        "/": ui._summary,
+                        "/corpus": ui._corpus,
+                        "/crash": ui._crash,
+                        "/cover": ui._cover,
+                        "/rawcover": ui._rawcover,
+                        "/prio": ui._prio,
+                        "/stats": ui._stats,
+                    }.get(url.path)
+                    if route is None:
+                        self.send_error(404)
+                        return
+                    ctype, body = route(q)
+                    self.send_response(200)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # pragma: no cover - defensive
+                    try:
+                        self.send_error(500, str(e))
+                    except Exception:
+                        pass
+
+        class _Server(http.server.ThreadingHTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = _Server((host, port), _Handler)
+        self.addr = "%s:%d" % self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    # ---- pages ----
+
+    def _summary(self, q) -> tuple:
+        m = self.mgr
+        snap = m.snapshot()
+        stats_rows = [[k, v] for k, v in sorted(snap.items())]
+        with m._lock:
+            crashes = sorted(m.crashes.values(),
+                             key=lambda e: -e.count)
+        crash_rows = [
+            [f'<a href="/crash?title={urllib.parse.quote(e.title)}">'
+             f'{_html.escape(e.title)}</a>', e.count] for e in crashes]
+        body = (
+            f'<p><a href="/corpus">corpus</a> | <a href="/cover">cover</a>'
+            f' | <a href="/prio">prio</a> | <a href="/rawcover">rawcover</a>'
+            f' | <a href="/stats">stats.json</a></p>'
+            + "<h2>stats</h2>" + _table(["stat", "value"], stats_rows)
+            + "<h2>crashes</h2>"
+            + _table(["title", "count"], crash_rows, raw=True))
+        return "text/html", _page(m.cfg.name, body)
+
+    def _corpus(self, q) -> tuple:
+        m = self.mgr
+        sig = q.get("sig")
+        if sig:
+            with m._lock:
+                text = m.corpus.get(sig, "")
+            return "text/plain", text.encode()
+        with m._lock:
+            items = [(h, t, len(m.corpus_signal.get(h, ())))
+                     for h, t in m.corpus.items()]
+        rows = [[f'<a href="/corpus?sig={h}">{h[:16]}</a>',
+                 _html.escape(",".join(call_set(t))[:80]), n]
+                for h, t, n in sorted(items, key=lambda it: -it[2])]
+        return "text/html", _page(
+            f"corpus ({len(rows)})",
+            _table(["prog", "calls", "signal"], rows, raw=True))
+
+    def _crash(self, q) -> tuple:
+        m = self.mgr
+        title = q.get("title", "")
+        from ..utils.hash import hash_str
+
+        d = os.path.join(m.crashdir, hash_str(title.encode())[:16])
+        if not os.path.isdir(d):
+            return "text/html", _page("crash", "unknown crash")
+        parts = [f"<h2>{_html.escape(title)}</h2>"]
+        for fn in sorted(os.listdir(d)):
+            p = os.path.join(d, fn)
+            with open(p, "rb") as f:
+                blob = f.read(1 << 16)
+            parts.append(f"<h3>{_html.escape(fn)}</h3><pre>"
+                         f"{_html.escape(blob.decode('utf-8', 'replace'))}"
+                         f"</pre>")
+        return "text/html", _page("crash", "".join(parts))
+
+    def _cover_pcs(self) -> List[int]:
+        m = self.mgr
+        with m._lock:
+            return sorted(getattr(m, "max_cover", ()))
+
+    def _cover(self, q) -> tuple:
+        pcs = self._cover_pcs()
+        if not pcs:
+            return "text/html", _page("cover", "no coverage data")
+        vmlinux = getattr(self.mgr.cfg, "kernel_obj", "")
+        if vmlinux and os.path.exists(vmlinux):
+            from ..report.symbolize import Symbolizer
+
+            # one symbolizer per UI instance: its PC cache makes repeated
+            # /cover views incremental instead of re-running addr2line
+            if not hasattr(self, "_sym"):
+                self._sym = Symbolizer(vmlinux)
+            frames = self._sym._resolve(pcs)
+            by_file: Dict[str, List[str]] = {}
+            for fr in frames:
+                file = fr.split(":")[0] if ":" in fr else "?"
+                by_file.setdefault(file, []).append(fr)
+            rows = [[f, len(v),
+                     ", ".join(sorted(set(v))[:8])]
+                    for f, v in sorted(by_file.items())]
+            body = _table(["file", "covered PCs", "frames"], rows)
+        else:
+            # raw fallback: group PCs by 64K region
+            by_region: Dict[int, int] = {}
+            for pc in pcs:
+                by_region[pc >> 16] = by_region.get(pc >> 16, 0) + 1
+            rows = [[hex(r << 16), n] for r, n in sorted(by_region.items())]
+            body = (f"<p>{len(pcs)} covered PCs "
+                    f"(no kernel_obj configured; raw regions)</p>"
+                    + _table(["region", "PCs"], rows))
+        return "text/html", _page(f"cover ({len(pcs)} PCs)", body)
+
+    def _rawcover(self, q) -> tuple:
+        pcs = self._cover_pcs()
+        return ("text/plain",
+                "".join(f"0x{pc:x}\n" for pc in pcs).encode())
+
+    def _prio(self, q) -> tuple:
+        m = self.mgr
+        from ..prog.prio import calculate_priorities
+        from ..prog.encoding import deserialize
+
+        with m._lock:
+            corpus = list(m.corpus.values())[:256]
+        progs = []
+        for t in corpus:
+            try:
+                progs.append(deserialize(m.target, t))
+            except Exception:
+                pass
+        prios = calculate_priorities(m.target, progs)
+        names = [s.name for s in m.target.syscalls]
+        # top-N strongest pairs, like reading the reference's /prio page
+        pairs = []
+        n = len(names)
+        for i in range(n):
+            row = prios[i]
+            for j in range(n):
+                if i != j and row[j] > 0.1:
+                    pairs.append((float(row[j]), names[i], names[j]))
+        pairs.sort(reverse=True)
+        rows = [[f"{p:.3f}", a, b] for p, a, b in pairs[:200]]
+        return "text/html", _page(
+            "call-pair priorities (top 200)",
+            _table(["prio", "call", "related"], rows))
+
+    def _stats(self, q) -> tuple:
+        return ("application/json",
+                json.dumps(self.mgr.snapshot(), sort_keys=True).encode())

@@ -40,22 +40,31 @@ class Symbolizer:
         return syms
 
     def _resolve(self, pcs: List[int]) -> List[str]:
-        proc = subprocess.run(
-            [self.addr2line, "-afi", "-e", self.vmlinux]
-            + [hex(pc) for pc in pcs],
-            capture_output=True, text=True, check=True)
-        locs: List[str] = []
-        cur: List[str] = []
-        for line in proc.stdout.splitlines():
-            if line.startswith("0x"):
-                if cur:
-                    locs.append(cur[-1])
-                cur = []
-            elif ":" in line:
-                cur.append(line.strip())
-        if cur:
-            locs.append(cur[-1])
-        return locs
+        """Resolve PCs to file:line, feeding addresses via stdin (argv
+        would hit ARG_MAX for the coverage-report-sized batches the /cover
+        page sends).  Results are memoized per PC."""
+        if not hasattr(self, "_resolve_cache"):
+            self._resolve_cache: Dict[int, str] = {}
+        todo = [pc for pc in pcs if pc not in self._resolve_cache]
+        if todo:
+            proc = subprocess.run(
+                [self.addr2line, "-afi", "-e", self.vmlinux],
+                input="".join(f"{pc:#x}\n" for pc in todo),
+                capture_output=True, text=True, check=True)
+            locs: List[str] = []
+            cur: List[str] = []
+            for line in proc.stdout.splitlines():
+                if line.startswith("0x"):
+                    if cur:
+                        locs.append(cur[-1])
+                    cur = []
+                elif ":" in line:
+                    cur.append(line.strip())
+            if cur:
+                locs.append(cur[-1])
+            for pc, loc in zip(todo, locs):
+                self._resolve_cache[pc] = loc
+        return [self._resolve_cache.get(pc, "??:0") for pc in pcs]
 
     def symbolize_report(self, report: str) -> str:
         """Append file:line to every frame whose symbol resolves."""

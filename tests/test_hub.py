@@ -1,0 +1,197 @@
+"""Hub federation tests.
+
+Covers what the reference exercises in syz-hub/state/state_test.go plus the
+hermetic two-manager exchange the reference never had (SURVEY.md §4 calls
+this gap out explicitly).
+"""
+
+import pytest
+
+from syzkaller_tpu.hub import (
+    Hub,
+    HubClient,
+    HubConfig,
+    HubState,
+    MAX_SYNC_RECORDS,
+)
+from syzkaller_tpu.manager import (
+    Manager,
+    ManagerConfig,
+    PHASE_TRIAGED_CORPUS,
+)
+from syzkaller_tpu.manager.rpc import RpcError
+from syzkaller_tpu.prog import get_target
+from syzkaller_tpu.prog.encoding import serialize
+from syzkaller_tpu.prog.generation import generate
+
+
+@pytest.fixture(scope="module")
+def target():
+    return get_target("linux", "amd64")
+
+
+@pytest.fixture(scope="module")
+def progs(target):
+    return [serialize(generate(target, seed, 4)) for seed in range(30)]
+
+
+# --------------------------------------------------------------------- #
+# HubState semantics
+
+
+def test_state_connect_sync_roundtrip(tmp_path, progs):
+    st = HubState(str(tmp_path))
+    st.connect("a", fresh=True, calls=["open", "close", "read", "write",
+                                       "mmap", "dup3", "socket"],
+               corpus=[])
+    st.connect("b", fresh=True, calls=["open", "close", "read", "write",
+                                       "mmap", "dup3", "socket"],
+               corpus=[])
+    text = "open(&0:0:0=\"./file0\\x00\", 0x0, 0x0)\n"
+    got, more = st.sync("a", add=[text], del_=[])
+    assert got == [] and more == 0  # own input never comes back
+    got, more = st.sync("b", add=[], del_=[])
+    assert got == [text] and more == 0
+    # second sync: no repeats
+    got, more = st.sync("b", add=[], del_=[])
+    assert got == []
+    st.close()
+
+
+def test_state_call_filtering(tmp_path):
+    st = HubState(str(tmp_path))
+    st.connect("a", fresh=True, calls=["open", "exotic_call"], corpus=[])
+    st.connect("b", fresh=True, calls=["open"], corpus=[])
+    st.sync("a", add=["exotic_call(0x0)\n", "open(0x0, 0x0, 0x0)\n"],
+            del_=[])
+    got, _ = st.sync("b", add=[], del_=[])
+    # b doesn't support exotic_call -> only the open program crosses
+    assert got == ["open(0x0, 0x0, 0x0)\n"]
+    st.close()
+
+
+def test_state_unconnected_rejected(tmp_path):
+    st = HubState(str(tmp_path))
+    with pytest.raises(RuntimeError):
+        st.sync("ghost", add=[], del_=[])
+    st.close()
+
+
+def test_state_more_backpressure(tmp_path):
+    st = HubState(str(tmp_path))
+    st.connect("a", fresh=True, calls=["open"], corpus=[])
+    st.connect("b", fresh=True, calls=["open"], corpus=[])
+    n = MAX_SYNC_RECORDS + 50
+    for i in range(n):
+        # distinct single-call programs; one sync per add gives distinct seqs
+        st.sync("a", add=[f"open(0x{i:x}, 0x0, 0x0)\n"], del_=[])
+    got1, more1 = st.sync("b", add=[], del_=[])
+    # the cap rounds up to a whole seq group (state.go:292-303), so the
+    # first page is MAX_SYNC_RECORDS + the boundary group
+    assert MAX_SYNC_RECORDS <= len(got1) <= MAX_SYNC_RECORDS + 1
+    assert more1 == n - len(got1)
+    got2, more2 = st.sync("b", add=[], del_=[])
+    assert len(got2) == more1 and more2 == 0
+    assert len(set(got1) | set(got2)) == n
+    st.close()
+
+
+def test_state_delete_and_purge(tmp_path):
+    st = HubState(str(tmp_path))
+    st.connect("a", fresh=True, calls=["open"], corpus=[])
+    text = "open(0x0, 0x0, 0x0)\n"
+    st.sync("a", add=[text], del_=[])
+    from syzkaller_tpu.utils.hash import hash_str
+
+    sig = hash_str(text.encode())
+    st.sync("a", add=[], del_=[sig])
+    # no manager mirrors the program anymore -> purged from the hub corpus
+    assert sig not in st.corpus
+    st.close()
+
+
+def test_state_persistence(tmp_path, progs):
+    st = HubState(str(tmp_path))
+    st.connect("a", fresh=True, calls=["open"], corpus=[])
+    st.connect("b", fresh=True, calls=["open"], corpus=[])
+    st.sync("a", add=["open(0x1, 0x0, 0x0)\n"], del_=[])
+    st.close()
+    # reload from disk: b (not fresh) must not re-receive what it already got
+    st2 = HubState(str(tmp_path))
+    st2.connect("b", fresh=False, calls=["open"], corpus=[])
+    got, _ = st2.sync("b", add=[], del_=[])
+    assert got == ["open(0x1, 0x0, 0x0)\n"]
+    st2.close()
+    st3 = HubState(str(tmp_path))
+    st3.connect("b", fresh=False, calls=["open"], corpus=[])
+    got, _ = st3.sync("b", add=[], del_=[])
+    assert got == []
+    st3.close()
+
+
+def test_repro_exchange(tmp_path):
+    st = HubState(str(tmp_path))
+    st.connect("a", fresh=True, calls=["open"], corpus=[])
+    st.connect("b", fresh=True, calls=["open"], corpus=[])
+    st.add_repro("a", "open(0x0, 0x0, 0x0)\n")
+    # originator never gets its own repro back
+    assert st.pending_repro("a") is None
+    assert st.pending_repro("b") == "open(0x0, 0x0, 0x0)\n"
+    assert st.pending_repro("b") is None  # delivered once
+    st.close()
+
+
+# --------------------------------------------------------------------- #
+# RPC service: auth + two managers federating end-to-end
+
+
+def test_hub_rpc_auth(tmp_path):
+    hub = Hub(HubConfig(workdir=str(tmp_path), clients={"mgr": "secret"}))
+    hub.start()
+    try:
+        bad = HubClient(hub.addr, "mgr", "wrong")
+        with pytest.raises(RpcError):
+            bad.connect(fresh=True, calls=[], corpus=[])
+        bad.close()
+        ok = HubClient(hub.addr, "mgr", "secret")
+        ok.connect(fresh=True, calls=["open"], corpus=[])
+        progs, more, repros = ok.sync(add=["open(0x0, 0x0, 0x0)\n"])
+        assert progs == [] and more == 0 and repros == []
+        ok.close()
+    finally:
+        hub.stop()
+
+
+def test_two_managers_federate(tmp_path, target, progs):
+    hub = Hub(HubConfig(workdir=str(tmp_path / "hub"),
+                        clients={"mgr-a": "ka", "mgr-b": "kb"}))
+    hub.start()
+    ma = mb = None
+    try:
+        ma = Manager(ManagerConfig(
+            name="mgr-a", workdir=str(tmp_path / "a"),
+            hub_addr=hub.addr, hub_key="ka"), target=target)
+        mb = Manager(ManagerConfig(
+            name="mgr-b", workdir=str(tmp_path / "b"),
+            hub_addr=hub.addr, hub_key="kb"), target=target)
+        # seed manager a's corpus as a fuzzer would (via new_input)
+        for t in progs[:5]:
+            ma.on_new_input("fuzz0", t, 0, [1, 2], [])
+        ma.phase = PHASE_TRIAGED_CORPUS
+        mb.phase = PHASE_TRIAGED_CORPUS
+        assert ma.hub_sync_once() == 0
+        got = mb.hub_sync_once()
+        assert got == 5
+        assert set(mb.candidates) == set(progs[:5])
+        # b contributes one more; a receives exactly the delta
+        mb.on_new_input("fuzz0", progs[10], 0, [3], [])
+        assert mb.hub_sync_once() == 0
+        assert ma.hub_sync_once() == 1
+        assert progs[10] in ma.candidates
+        assert ma.stats.get("hub_recv") == 1
+    finally:
+        if ma:
+            ma.close()
+        if mb:
+            mb.close()
+        hub.stop()
