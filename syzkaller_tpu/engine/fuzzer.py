@@ -52,6 +52,7 @@ class FuzzerConfig:
     sandbox: str = "none"
     device_period: int = 16             # consume a device batch every N steps
     env_config: Optional[EnvConfig] = None
+    detect_supported: bool = False      # probe the live machine (pkg/host)
 
 
 class ManagerConn:
@@ -95,6 +96,14 @@ class Fuzzer:
 
         conn = self.manager.connect()
         self._enabled = conn.get("enabled")
+        if self.cfg.detect_supported:
+            # buildCallList (reference fuzzer.go:430-465): manager-enabled
+            # calls intersected with what this machine supports, closed
+            # under resource-ctor reachability
+            from .. import host as _host
+
+            self._enabled = sorted(_host.build_call_list(
+                target, enabled=self._enabled))
         self.choice_table = build_choice_table(
             target, conn.get("prios"), self._enabled)
         self.max_signal.update(conn.get("max_signal", ()))

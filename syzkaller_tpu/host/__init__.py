@@ -1,0 +1,137 @@
+"""Supported-syscall detection on the live machine.
+
+Role parity with reference /root/reference/pkg/host/host_linux.go:19-160:
+the primary strategy is the /proc/kallsyms symbol probe (` T sys_<name>`,
+the most reliable of the three strategies the reference enumerates); socket
+variants are probed by actually creating a socket of that family, open
+variants by opening their constant filename, and syz_* pseudo-calls by
+checking the device/feature they need.  The result feeds
+`Target.transitively_enabled_calls` so calls whose input resources have no
+supported constructor are disabled too (reference syz-fuzzer/fuzzer.go:
+430-465 buildCallList).
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import socket
+from typing import Dict, Iterable, Optional, Set
+
+from ..prog.types import BufferKind, BufferType, ConstType, PtrType, Syscall
+
+
+def _read_kallsyms(path: str = "/proc/kallsyms") -> bytes:
+    try:
+        with open(path, "rb") as f:
+            return f.read()
+    except OSError:
+        return b""
+
+
+def _string_const(typ) -> Optional[str]:
+    """The single constant value of a ptr[in, string["..."]] arg."""
+    if not isinstance(typ, PtrType):
+        return None
+    s = typ.elem
+    if not isinstance(s, BufferType) or s.kind != BufferKind.STRING \
+            or len(s.values) != 1:
+        return None
+    v = s.values[0]
+    return v[:-1] if v.endswith("\x00") else v
+
+
+def _supported_socket(meta: Syscall) -> bool:
+    """Create a socket of the declared family (host_linux.go:112-123)."""
+    af = meta.args[0]
+    if not isinstance(af, ConstType):
+        return True
+    try:
+        s = socket.socket(af.val, socket.SOCK_DGRAM, 0)
+        s.close()
+        return True
+    except OSError as e:
+        if e.errno in (errno.ENOSYS, errno.EAFNOSUPPORT):
+            return False
+        # EPERM/EPROTONOSUPPORT etc.: family exists, kernel said no for
+        # other reasons — the reference treats these as supported
+        return True
+
+
+def _supported_open(meta: Syscall, fname_arg: int) -> bool:
+    fname = _string_const(meta.args[fname_arg])
+    if fname is None:
+        return True
+    try:
+        fd = os.open(fname, os.O_RDONLY)
+        os.close(fd)
+        return True
+    except OSError:
+        return False
+
+
+def _supported_syz(meta: Syscall) -> bool:
+    """Pseudo-syscalls: check the kernel feature they wrap
+    (host_linux.go:59-110)."""
+    cn = meta.call_name
+    if cn == "syz_test":
+        return False
+    if cn == "syz_open_dev":
+        fname = _string_const(meta.args[0]) if meta.args else None
+        if fname is None:
+            return True
+        if os.getuid() != 0:
+            return False
+        if "#" not in fname:
+            return os.path.exists(fname)
+        return any(os.path.exists(fname.replace("#", str(i)))
+                   for i in range(5))
+    if cn == "syz_open_pts":
+        return os.path.exists("/dev/ptmx")
+    if cn == "syz_kvm_setup_cpu":
+        return os.path.exists("/dev/kvm")
+    if cn in ("syz_emit_ethernet", "syz_extract_tcp_res"):
+        return os.path.exists("/dev/net/tun")
+    if cn in ("syz_fuse_mount", "syz_fusectl_mount"):
+        return os.path.exists("/dev/fuse")
+    return True
+
+
+def is_supported(kallsyms: bytes, meta: Syscall) -> bool:
+    if meta.call_name.startswith("syz_"):
+        return _supported_syz(meta)
+    if meta.name.startswith("socket$"):
+        return _supported_socket(meta)
+    if meta.name.startswith("open$"):
+        return _supported_open(meta, 0)
+    if meta.name.startswith("openat$"):
+        return _supported_open(meta, 1)
+    if not kallsyms:
+        return True  # no CONFIG_KALLSYMS: assume everything, like the ref
+    for prefix in (b" T sys_", b" T __x64_sys_", b" T __arm64_sys_",
+                   b" W sys_", b" T ksys_"):
+        if prefix + meta.call_name.encode() + b"\n" in kallsyms:
+            return True
+    return False
+
+
+def detect_supported_syscalls(target,
+                              kallsyms: Optional[bytes] = None
+                              ) -> Dict[int, bool]:
+    """syscall id -> supported on this machine (host_linux.go:19-38)."""
+    if kallsyms is None:
+        kallsyms = _read_kallsyms()
+    return {meta.id: is_supported(kallsyms, meta)
+            for meta in target.syscalls}
+
+
+def build_call_list(target, enabled: Optional[Iterable[int]] = None,
+                    kallsyms: Optional[bytes] = None) -> Set[int]:
+    """Supported ∩ enabled, closed under resource-constructor
+    reachability (fuzzer.go:430-465).  Returns syscall ids."""
+    supported = detect_supported_syscalls(target, kallsyms)
+    ids = {i for i, ok in supported.items() if ok}
+    if enabled is not None:
+        ids &= set(enabled)
+    metas = [target.syscalls[i] for i in sorted(ids)]
+    return {c.id for c in target.transitively_enabled_calls(metas)}

@@ -106,7 +106,15 @@ class _HubManager:
         self.corpus_seq = _load_seq(self.seq_file)
         self.repro_seq = _load_seq(self.repro_seq_file)
         self.calls: Set[str] = set()
+        # persisted: after a restart a manager must still never get its own
+        # reproducer delivered back to it
+        self._own_repros_file = os.path.join(dir_, "own.repros")
         self.own_repros: Set[str] = set()
+        try:
+            self.own_repros = set(json.loads(
+                open(self._own_repros_file).read()))
+        except (OSError, ValueError):
+            pass
         self.connected = 0.0
         # running totals for the hub status page / tests
         self.added = self.deleted = self.new = 0
@@ -139,8 +147,16 @@ class HubState:
         os.makedirs(dir_, exist_ok=True)
         self.corpus = _SeqDB(os.path.join(dir_, "corpus.db"))
         self.repros = _SeqDB(os.path.join(dir_, "repro.db"))
-        self.corpus_seq = self.corpus.max_seq
-        self.repro_seq = self.repros.max_seq
+        # the global counters are persisted independently of the records:
+        # deriving them from surviving record seqs alone could regress the
+        # counter below a manager's persisted cursor after deletions +
+        # restart, permanently hiding newer inputs from that manager
+        self._corpus_seq_file = os.path.join(dir_, "corpus.seq")
+        self._repro_seq_file = os.path.join(dir_, "repro.seq")
+        self.corpus_seq = max(self.corpus.max_seq,
+                              _load_seq(self._corpus_seq_file))
+        self.repro_seq = max(self.repros.max_seq,
+                             _load_seq(self._repro_seq_file))
         self.managers: Dict[str, _HubManager] = {}
         mdir = os.path.join(dir_, "manager")
         os.makedirs(mdir, exist_ok=True)
@@ -208,6 +224,10 @@ class HubState:
         if sig in self.repros:
             return
         mgr.own_repros.add(sig)
+        tmp = mgr._own_repros_file + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(sorted(mgr.own_repros), f)
+        os.replace(tmp, mgr._own_repros_file)
         mgr.sent_repros += 1
         if mgr.repro_seq == self.repro_seq:
             mgr.repro_seq += 1
@@ -215,6 +235,7 @@ class HubState:
         self.repro_seq += 1
         self.repros.save(sig, repro.encode(), self.repro_seq)
         self.repros.flush()
+        _save_seq(self._repro_seq_file, self.repro_seq)
 
     def pending_repro(self, name: str) -> Optional[str]:
         mgr = self.managers.get(name)
@@ -247,16 +268,19 @@ class HubState:
     def _add_inputs(self, mgr: _HubManager, inputs: Sequence[str]) -> None:
         if not inputs:
             return
-        self.corpus_seq += 1
         for text in inputs:
             if not call_set(text):
                 continue
             sig = hash_str(text.encode())
             mgr.corpus.save(sig, b"", 0)
             if sig not in self.corpus:
+                # per-record seqs (not per-batch): a 100k-program connect
+                # must still page out MAX_SYNC_RECORDS at a time
+                self.corpus_seq += 1
                 self.corpus.save(sig, text.encode(), self.corpus_seq)
         mgr.corpus.flush()
         self.corpus.flush()
+        _save_seq(self._corpus_seq_file, self.corpus_seq)
 
     def _pending_inputs(self, mgr: _HubManager) -> Tuple[List[str], int]:
         """Deltas since the manager's cursor, call-filtered, capped at
@@ -278,14 +302,15 @@ class HubState:
         more = 0
         if len(records) > MAX_SYNC_RECORDS:
             records.sort()
-            pos = MAX_SYNC_RECORDS
-            max_seq = records[pos][0]
-            # round up to a whole seq group so the cursor stays consistent
-            while pos + 1 < len(records) and records[pos + 1][0] == max_seq:
-                pos += 1
-            pos += 1
-            more = len(records) - pos
-            records = records[:pos]
+            # cut after MAX records, extended through the last included
+            # record's whole seq group so the cursor stays consistent
+            cut = MAX_SYNC_RECORDS
+            last_seq = records[cut - 1][0]
+            while cut < len(records) and records[cut][0] == last_seq:
+                cut += 1
+            more = len(records) - cut
+            records = records[:cut]
+            max_seq = last_seq
         mgr.corpus_seq = max_seq
         _save_seq(mgr.seq_file, mgr.corpus_seq)
         return [text for _, _, text in records], more

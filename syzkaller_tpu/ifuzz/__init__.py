@@ -1,0 +1,412 @@
+"""x86 machine-code generation/mutation for `text` buffer args.
+
+Role parity with reference /root/reference/pkg/ifuzz (ifuzz.go:9-40
+Generate/Mutate/Decode over an instruction table; the reference's table is
+generated from Intel XED).  This implementation is original: a hand-curated
+table of ~120 encodings chosen for kernel-interest (privileged ops, mode
+switches, MSR/CR access, interrupts, string ops, branches) plus a compact
+encoder — enough to synthesize plausible guest code for KVM fuzzing
+(`syz_kvm_setup_cpu` payloads) and `text[x86_64]` args.
+
+Layout note for the device path: `table_rows()` exports the table as
+fixed-width numpy template rows (template bytes, length, imm offset/size)
+that ops/textgen.py turns into a vectorized TPU batch generator.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+# modes (reference ifuzz.go:16-22)
+MODE_LONG64 = 0
+MODE_PROT32 = 1
+MODE_PROT16 = 2
+MODE_REAL16 = 3
+MODE_LAST = 4
+
+_ALL = (1 << MODE_LAST) - 1
+_32_PLUS = (1 << MODE_LONG64) | (1 << MODE_PROT32)
+_LEGACY = (1 << MODE_PROT32) | (1 << MODE_PROT16) | (1 << MODE_REAL16)
+
+
+@dataclass(frozen=True)
+class Insn:
+    name: str
+    opcode: bytes
+    mode: int = _ALL          # bitmask of compatible modes
+    modrm: bool = False       # needs a ModRM byte
+    imm: int = 0              # immediate bytes (-1: operand-size 2/4)
+    priv: bool = False        # CPL0-only
+    rexw: int = 0             # 1: REX.W required (long mode only)
+    fixed_modrm: int = -1     # >=0: the encoder must use exactly this ModRM
+
+
+def _i(name, opcode, **kw) -> Insn:
+    return Insn(name=name, opcode=bytes(opcode), **kw)
+
+
+# Curated instruction table.  Unprivileged first, privileged at the end.
+INSNS: List[Insn] = [
+    # one-byte no-operand
+    _i("nop", [0x90]),
+    _i("cwde", [0x98]),
+    _i("cdq", [0x99]),
+    _i("sahf", [0x9E]),
+    _i("lahf", [0x9F]),
+    _i("ret", [0xC3]),
+    _i("leave", [0xC9]),
+    _i("int3", [0xCC]),
+    _i("into", [0xCE], mode=_LEGACY),
+    _i("iret", [0xCF]),
+    _i("cmc", [0xF5]),
+    _i("clc", [0xF8]),
+    _i("stc", [0xF9]),
+    _i("cld", [0xFC]),
+    _i("std", [0xFD]),
+    _i("pusha", [0x60], mode=_LEGACY),
+    _i("popa", [0x61], mode=_LEGACY),
+    _i("pushf", [0x9C]),
+    _i("popf", [0x9D]),
+    _i("xlat", [0xD7]),
+    _i("ud2", [0x0F, 0x0B]),
+    _i("cpuid", [0x0F, 0xA2]),
+    _i("rdtsc", [0x0F, 0x31]),
+    _i("emms", [0x0F, 0x77]),
+    # push/pop register (register embedded in opcode)
+    *[_i(f"push_r{r}", [0x50 + r]) for r in range(8)],
+    *[_i(f"pop_r{r}", [0x58 + r]) for r in range(8)],
+    # immediates
+    _i("push_imm8", [0x6A], imm=1),
+    _i("push_imm", [0x68], imm=-1),
+    _i("int_imm8", [0xCD], imm=1),
+    _i("ret_imm16", [0xC2], imm=2),
+    _i("mov_al_imm8", [0xB0], imm=1),
+    _i("mov_eax_imm", [0xB8], imm=-1),
+    _i("add_al_imm8", [0x04], imm=1),
+    _i("add_eax_imm", [0x05], imm=-1),
+    _i("sub_al_imm8", [0x2C], imm=1),
+    _i("sub_eax_imm", [0x2D], imm=-1),
+    _i("and_al_imm8", [0x24], imm=1),
+    _i("or_al_imm8", [0x0C], imm=1),
+    _i("xor_al_imm8", [0x34], imm=1),
+    _i("cmp_al_imm8", [0x3C], imm=1),
+    _i("cmp_eax_imm", [0x3D], imm=-1),
+    _i("test_al_imm8", [0xA8], imm=1),
+    _i("test_eax_imm", [0xA9], imm=-1),
+    _i("in_al_imm8", [0xE4], imm=1, priv=True),
+    _i("in_eax_imm8", [0xE5], imm=1, priv=True),
+    _i("out_imm8_al", [0xE6], imm=1, priv=True),
+    _i("out_imm8_eax", [0xE7], imm=1, priv=True),
+    _i("in_al_dx", [0xEC], priv=True),
+    _i("out_dx_al", [0xEE], priv=True),
+    # short branches
+    *[_i(f"j{cc:x}_rel8", [0x70 + cc], imm=1) for cc in range(16)],
+    _i("jmp_rel8", [0xEB], imm=1),
+    _i("jmp_rel", [0xE9], imm=-1),
+    _i("call_rel", [0xE8], imm=-1),
+    _i("loop", [0xE2], imm=1),
+    _i("loope", [0xE1], imm=1),
+    _i("loopne", [0xE0], imm=1),
+    _i("jcxz", [0xE3], imm=1),
+    # string ops (with/without rep handled by prefix sampling)
+    _i("movsb", [0xA4]),
+    _i("movs", [0xA5]),
+    _i("stosb", [0xAA]),
+    _i("stos", [0xAB]),
+    _i("lodsb", [0xAC]),
+    _i("lods", [0xAD]),
+    _i("scasb", [0xAE]),
+    _i("scas", [0xAF]),
+    _i("cmpsb", [0xA6]),
+    _i("cmps", [0xA7]),
+    _i("insb", [0x6C], priv=True),
+    _i("ins", [0x6D], priv=True),
+    _i("outsb", [0x6E], priv=True),
+    _i("outs", [0x6F], priv=True),
+    # modrm r/m forms
+    _i("add_rm_r", [0x01], modrm=True),
+    _i("add_r_rm", [0x03], modrm=True),
+    _i("or_rm_r", [0x09], modrm=True),
+    _i("and_rm_r", [0x21], modrm=True),
+    _i("sub_rm_r", [0x29], modrm=True),
+    _i("xor_rm_r", [0x31], modrm=True),
+    _i("cmp_rm_r", [0x39], modrm=True),
+    _i("mov_rm_r", [0x89], modrm=True),
+    _i("mov_r_rm", [0x8B], modrm=True),
+    _i("test_rm_r", [0x85], modrm=True),
+    _i("xchg_rm_r", [0x87], modrm=True),
+    _i("lea", [0x8D], modrm=True),
+    _i("mov_rm_imm", [0xC7], modrm=True, imm=-1),
+    _i("mov_rm8_imm8", [0xC6], modrm=True, imm=1),
+    _i("grp1_rm_imm8", [0x83], modrm=True, imm=1),
+    _i("grp1_rm_imm", [0x81], modrm=True, imm=-1),
+    _i("shift_rm_1", [0xD1], modrm=True),
+    _i("shift_rm_cl", [0xD3], modrm=True),
+    _i("shift_rm_imm8", [0xC1], modrm=True, imm=1),
+    _i("inc_dec_rm", [0xFF], modrm=True),
+    _i("neg_not_rm", [0xF7], modrm=True),
+    _i("movzx_r_rm8", [0x0F, 0xB6], modrm=True),
+    _i("movsx_r_rm8", [0x0F, 0xBE], modrm=True),
+    _i("imul_r_rm", [0x0F, 0xAF], modrm=True),
+    _i("bt_rm_r", [0x0F, 0xA3], modrm=True),
+    _i("bts_rm_r", [0x0F, 0xAB], modrm=True),
+    _i("bsf_r_rm", [0x0F, 0xBC], modrm=True),
+    _i("setcc_rm8", [0x0F, 0x94], modrm=True),
+    _i("cmovz_r_rm", [0x0F, 0x44], modrm=True),
+    _i("jcc_rel", [0x0F, 0x84], imm=-1),
+    _i("xadd_rm_r", [0x0F, 0xC1], modrm=True),
+    _i("cmpxchg_rm_r", [0x0F, 0xB1], modrm=True),
+    # system / privileged (the interesting ones for KVM fuzzing)
+    _i("syscall", [0x0F, 0x05], mode=1 << MODE_LONG64),
+    _i("sysenter", [0x0F, 0x34], mode=_32_PLUS),
+    _i("sysexit", [0x0F, 0x35], mode=_32_PLUS, priv=True),
+    _i("sysret", [0x0F, 0x07], mode=1 << MODE_LONG64, priv=True),
+    _i("hlt", [0xF4], priv=True),
+    _i("cli", [0xFA], priv=True),
+    _i("sti", [0xFB], priv=True),
+    _i("clts", [0x0F, 0x06], priv=True),
+    _i("invd", [0x0F, 0x08], priv=True),
+    _i("wbinvd", [0x0F, 0x09], priv=True),
+    _i("rdmsr", [0x0F, 0x32], priv=True),
+    _i("wrmsr", [0x0F, 0x30], priv=True),
+    _i("rdpmc", [0x0F, 0x33], priv=True),
+    _i("rsm", [0x0F, 0xAA], priv=True),
+    _i("mov_cr0_r", [0x0F, 0x22], priv=True, fixed_modrm=0xC0),
+    _i("mov_r_cr0", [0x0F, 0x20], priv=True, fixed_modrm=0xC0),
+    _i("mov_cr3_r", [0x0F, 0x22], priv=True, fixed_modrm=0xD8),
+    _i("mov_r_cr3", [0x0F, 0x20], priv=True, fixed_modrm=0xD8),
+    _i("mov_cr4_r", [0x0F, 0x22], priv=True, fixed_modrm=0xE0),
+    _i("mov_dr_r", [0x0F, 0x23], priv=True, fixed_modrm=0xC0),
+    _i("lmsw_r", [0x0F, 0x01], priv=True, fixed_modrm=0xF0),
+    _i("smsw_r", [0x0F, 0x01], priv=True, fixed_modrm=0xE0),
+    _i("sgdt", [0x0F, 0x01], modrm=True, fixed_modrm=0x00, priv=True),
+    _i("sidt", [0x0F, 0x01], modrm=True, fixed_modrm=0x08, priv=True),
+    _i("lgdt", [0x0F, 0x01], modrm=True, fixed_modrm=0x10, priv=True),
+    _i("lidt", [0x0F, 0x01], modrm=True, fixed_modrm=0x18, priv=True),
+    _i("invlpg", [0x0F, 0x01], modrm=True, fixed_modrm=0x38, priv=True),
+    _i("vmcall", [0x0F, 0x01], fixed_modrm=0xC1, priv=True),
+    _i("vmlaunch", [0x0F, 0x01], fixed_modrm=0xC2, priv=True),
+    _i("vmresume", [0x0F, 0x01], fixed_modrm=0xC3, priv=True),
+    _i("vmxoff", [0x0F, 0x01], fixed_modrm=0xC4, priv=True),
+    _i("monitor", [0x0F, 0x01], fixed_modrm=0xC8, priv=True),
+    _i("mwait", [0x0F, 0x01], fixed_modrm=0xC9, priv=True),
+    _i("swapgs", [0x0F, 0x01], fixed_modrm=0xF8,
+       mode=1 << MODE_LONG64, priv=True),
+    _i("rdtscp", [0x0F, 0x01], fixed_modrm=0xF9),
+    _i("ltr_r", [0x0F, 0x00], fixed_modrm=0xD8, priv=True),
+    _i("str_r", [0x0F, 0x00], fixed_modrm=0xC8),
+    _i("lldt_r", [0x0F, 0x00], fixed_modrm=0xD0, priv=True),
+    _i("sldt_r", [0x0F, 0x00], fixed_modrm=0xC0),
+]
+
+_PREFIXES = bytes([0x66, 0x67, 0xF0, 0xF2, 0xF3, 0x2E, 0x36, 0x3E, 0x26])
+
+_INTERESTING_IMM = [0, 1, 0x7F, 0x80, 0xFF, 0x100, 0x7FFF, 0x8000,
+                    0xFFFF, 0x10000, 0x7FFFFFFF, 0x80000000, 0xFFFFFFFF]
+
+
+@dataclass
+class Config:
+    """Reference ifuzz.Config (ifuzz.go:57-63)."""
+
+    length: int = 10        # number of instructions
+    mode: int = MODE_LONG64
+    priv: bool = True       # allow CPL0 instructions
+    exec_: bool = True      # unused hook for pseudo-ops parity
+
+
+def mode_insns(cfg: Config) -> List[Insn]:
+    return [i for i in INSNS
+            if (i.mode >> cfg.mode) & 1 and (cfg.priv or not i.priv)]
+
+
+def _imm_size(insn: Insn, cfg: Config) -> int:
+    if insn.imm >= 0:
+        return insn.imm
+    # operand-size immediate: 4 in 32/64-bit modes, 2 in 16-bit modes
+    return 4 if cfg.mode in (MODE_LONG64, MODE_PROT32) else 2
+
+
+def _gen_imm(rng: random.Random, size: int) -> bytes:
+    if rng.random() < 0.5:
+        v = rng.choice(_INTERESTING_IMM)
+    else:
+        v = rng.getrandbits(size * 8)
+    return (v & ((1 << (size * 8)) - 1)).to_bytes(size, "little")
+
+
+def encode_insn(insn: Insn, cfg: Config, rng: random.Random) -> bytes:
+    out = bytearray()
+    # optional legacy prefixes (sparingly, like real code)
+    while rng.random() < 0.12:
+        out.append(rng.choice(_PREFIXES))
+    if cfg.mode == MODE_LONG64 and (insn.rexw == 1 or rng.random() < 0.2):
+        rex = 0x40 | (0x08 if insn.rexw == 1 or rng.random() < 0.5 else 0)
+        rex |= rng.getrandbits(3)  # R/X/B extension bits
+        out.append(rex)
+    out += insn.opcode
+    if insn.fixed_modrm >= 0:
+        out.append(insn.fixed_modrm)
+        if insn.modrm and (insn.fixed_modrm >> 6) == 0:
+            # memory form mod=00: maybe disp (rm=101 -> disp32/16)
+            if (insn.fixed_modrm & 7) == 5:
+                out += _gen_imm(rng, 4 if cfg.mode != MODE_REAL16 else 2)
+    elif insn.modrm:
+        mod = rng.choice([0, 1, 2, 3])
+        reg = rng.getrandbits(3)
+        rm = rng.getrandbits(3)
+        out.append((mod << 6) | (reg << 3) | rm)
+        if mod != 3:
+            if cfg.mode == MODE_REAL16 or cfg.mode == MODE_PROT16:
+                if mod == 1:
+                    out += _gen_imm(rng, 1)
+                elif mod == 2 or (mod == 0 and rm == 6):
+                    out += _gen_imm(rng, 2)
+            else:
+                if rm == 4:  # SIB
+                    out.append(rng.getrandbits(8))
+                if mod == 1:
+                    out += _gen_imm(rng, 1)
+                elif mod == 2 or (mod == 0 and rm == 5):
+                    out += _gen_imm(rng, 4)
+    sz = _imm_size(insn, cfg)
+    if sz:
+        out += _gen_imm(rng, sz)
+    return bytes(out)
+
+
+def generate(cfg: Config, rng: Optional[random.Random] = None) -> bytes:
+    """cfg.length instructions of mode-appropriate machine code
+    (reference ifuzz.go:118-126)."""
+    rng = rng or random.Random()
+    pool = mode_insns(cfg)
+    out = bytearray()
+    for _ in range(cfg.length):
+        out += encode_insn(rng.choice(pool), cfg, rng)
+    return bytes(out)
+
+
+def mutate(cfg: Config, text: bytes,
+           rng: Optional[random.Random] = None) -> bytes:
+    """Instruction-granular mutation (reference ifuzz.go:127-190): split
+    into insns (greedy table decode, 1-byte fallback), then insert /
+    remove / replace / byte-perturb."""
+    rng = rng or random.Random()
+    chunks = split(cfg, text)
+    if not chunks:
+        return generate(cfg, rng)
+    for _ in range(rng.randint(1, 3)):
+        op = rng.randrange(4)
+        idx = rng.randrange(len(chunks))
+        if op == 0:  # insert
+            chunks.insert(idx, encode_insn(
+                rng.choice(mode_insns(cfg)), cfg, rng))
+        elif op == 1 and len(chunks) > 1:  # remove
+            del chunks[idx]
+        elif op == 2:  # replace
+            chunks[idx] = encode_insn(rng.choice(mode_insns(cfg)), cfg, rng)
+        else:  # byte perturbation inside one insn
+            b = bytearray(chunks[idx])
+            if b:
+                pos = rng.randrange(len(b))
+                b[pos] ^= 1 << rng.randrange(8)
+                chunks[idx] = bytes(b)
+    return b"".join(chunks)
+
+
+def decode(cfg: Config, data: bytes) -> int:
+    """Length of the instruction at data[0:], or -1 if not in our table
+    (reference decode.go's role, against our own table)."""
+    pos = 0
+    n = len(data)
+    while pos < n and data[pos] in _PREFIXES:
+        pos += 1
+    if cfg.mode == MODE_LONG64 and pos < n and 0x40 <= data[pos] <= 0x4F:
+        pos += 1
+    best = -1
+    for insn in INSNS:
+        if not (insn.mode >> cfg.mode) & 1:
+            continue
+        op = insn.opcode
+        if data[pos:pos + len(op)] != op:
+            continue
+        p = pos + len(op)
+        if insn.fixed_modrm >= 0:
+            if p >= n or data[p] != insn.fixed_modrm:
+                continue
+            p += 1
+            if insn.modrm and (insn.fixed_modrm >> 6) == 0 \
+                    and (insn.fixed_modrm & 7) == 5:
+                p += 4 if cfg.mode != MODE_REAL16 else 2
+        elif insn.modrm:
+            if p >= n:
+                continue
+            modrm = data[p]
+            p += 1
+            mod, rm = modrm >> 6, modrm & 7
+            if cfg.mode in (MODE_REAL16, MODE_PROT16):
+                if mod == 1:
+                    p += 1
+                elif mod == 2 or (mod == 0 and rm == 6):
+                    p += 2
+            else:
+                if mod != 3 and rm == 4:
+                    p += 1
+                if mod == 1:
+                    p += 1
+                elif mod == 2 or (mod == 0 and rm == 5):
+                    p += 4
+        p += _imm_size(insn, cfg)
+        if p <= n and p > best:
+            best = p
+    return best
+
+
+def split(cfg: Config, text: bytes) -> List[bytes]:
+    chunks: List[bytes] = []
+    pos = 0
+    while pos < len(text):
+        ln = decode(cfg, text[pos:])
+        if ln <= 0:
+            ln = 1
+        chunks.append(text[pos:pos + ln])
+        pos += ln
+    return chunks
+
+
+# ---------------------------------------------------------------------- #
+# device export: fixed-width template rows for ops/textgen.py
+
+
+def table_rows(cfg: Config, max_len: int = 16):
+    """(templates [N, max_len] u8, lengths [N], imm_off [N], imm_size [N]):
+    one deterministic encoding per table entry (mod=3 modrm, zero imm),
+    with the imm window exposed so device lanes can randomize it."""
+    import numpy as np
+
+    rng = random.Random(0)
+    rows, lens, ioff, isz = [], [], [], []
+    for insn in mode_insns(cfg):
+        enc = bytearray(insn.opcode)
+        if insn.fixed_modrm >= 0:
+            enc.append(insn.fixed_modrm)
+            if insn.modrm and (insn.fixed_modrm >> 6) == 0 \
+                    and (insn.fixed_modrm & 7) == 5:
+                enc += b"\x00\x00\x00\x00"
+        elif insn.modrm:
+            enc.append(0xC0 | (rng.getrandbits(3) << 3) | rng.getrandbits(3))
+        sz = _imm_size(insn, cfg)
+        off = len(enc) if sz else 0
+        enc += b"\x00" * sz
+        if len(enc) > max_len:
+            continue
+        lens.append(len(enc))
+        ioff.append(off)
+        isz.append(sz)
+        rows.append(bytes(enc) + b"\x00" * (max_len - len(enc)))
+    return (np.frombuffer(b"".join(rows),
+                          dtype=np.uint8).reshape(len(rows), max_len).copy(),
+            np.asarray(lens, np.int32), np.asarray(ioff, np.int32),
+            np.asarray(isz, np.int32))

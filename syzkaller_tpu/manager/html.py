@@ -56,6 +56,8 @@ class ManagerHttp:
 
     def __init__(self, mgr, host: str = "127.0.0.1", port: int = 0):
         self.mgr = mgr
+        self._sym = None
+        self._sym_lock = threading.Lock()
         ui = self
 
         class _Handler(http.server.BaseHTTPRequestHandler):
@@ -178,10 +180,13 @@ class ManagerHttp:
             from ..report.symbolize import Symbolizer
 
             # one symbolizer per UI instance: its PC cache makes repeated
-            # /cover views incremental instead of re-running addr2line
-            if not hasattr(self, "_sym"):
-                self._sym = Symbolizer(vmlinux)
-            frames = self._sym._resolve(pcs)
+            # /cover views incremental instead of re-running addr2line.
+            # Guarded by a lock: handler threads race on first view, and
+            # the cache itself isn't thread-safe.
+            with self._sym_lock:
+                if self._sym is None:
+                    self._sym = Symbolizer(vmlinux)
+                frames = self._sym._resolve(pcs)
             by_file: Dict[str, List[str]] = {}
             for fr in frames:
                 file = fr.split(":")[0] if ":" in fr else "?"

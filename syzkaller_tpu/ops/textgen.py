@@ -1,0 +1,89 @@
+"""Vectorized x86 text-arg generation on device.
+
+Device counterpart of ifuzz.generate (reference pkg/ifuzz generates text
+args one relocation at a time on the host): each batch lane assembles a
+short instruction stream by sampling template rows from the exported
+ifuzz table (ifuzz.table_rows) and scattering them into a byte arena,
+randomizing the immediate windows.  One jit, [B] programs per dispatch —
+this is how `text[x86_64]` args get filled when the TPU mutation pipeline
+produces candidates, without bouncing back to the host.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+from . import ensure_x64  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .rng import randpool
+
+U64 = jnp.uint64
+
+
+class TextTables:
+    """Device-resident ifuzz template table for one mode."""
+
+    def __init__(self, mode: int = 0, max_len: int = 16):
+        from .. import ifuzz
+
+        cfg = ifuzz.Config(mode=mode)
+        tmpl, lens, ioff, isz = ifuzz.table_rows(cfg, max_len=max_len)
+        self.n = tmpl.shape[0]
+        self.max_len = max_len
+        self.templates = jnp.asarray(tmpl)           # [N, L] u8
+        self.lengths = jnp.asarray(lens)             # [N]
+        self.imm_off = jnp.asarray(ioff)             # [N]
+        self.imm_size = jnp.asarray(isz)             # [N]
+
+
+def _gen_one(pool, tt: TextTables, n_insns: int, cap: int):
+    """One lane: scatter n_insns sampled templates into a [cap] arena.
+    pool: [n_insns, 2] u64 words (pick, imm)."""
+    picks = (pool[:, 0] % U64(tt.n)).astype(jnp.int32)      # [K]
+    imms = pool[:, 1]                                        # [K]
+    lens = tt.lengths[picks]                                 # [K]
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(lens)[:-1]])  # [K]
+
+    rows = tt.templates[picks]                               # [K, L]
+    # randomize each row's imm window from its pool word
+    off = tt.imm_off[picks][:, None]                         # [K, 1]
+    isz = tt.imm_size[picks][:, None]
+    lane = jnp.arange(tt.max_len)[None, :]
+    byte_idx = lane - off
+    in_imm = (isz > 0) & (byte_idx >= 0) & (byte_idx < isz)
+    imm_bytes = ((imms[:, None] >> (byte_idx.clip(0, 7) * 8).astype(U64))
+                 & U64(0xFF)).astype(jnp.uint8)
+    rows = jnp.where(in_imm, imm_bytes, rows)
+
+    # scatter rows into the arena at their cumulative offsets
+    flat_pos = (starts[:, None] + lane).reshape(-1)          # [K*L]
+    valid = (lane < lens[:, None]).reshape(-1)
+    flat_pos = jnp.where(valid, flat_pos, cap)  # out-of-range = dropped
+    arena = jnp.zeros((cap + 1,), jnp.uint8)
+    arena = arena.at[flat_pos].set(rows.reshape(-1))
+    total = jnp.minimum(jnp.sum(lens), cap)
+    return arena[:cap], total
+
+
+@partial(jax.jit, static_argnames=("tt", "n_insns", "cap", "B"))
+def generate_text_batch(key, tt: TextTables, *, B: int, n_insns: int = 8,
+                        cap: int = 128) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """[B, cap] u8 instruction streams + [B] lengths."""
+    pool = randpool(key, (B, n_insns), 2)
+    return jax.vmap(lambda p: _gen_one(p, tt, n_insns, cap))(pool)
+
+
+_tt_cache = {}
+
+
+def get_text_tables(mode: int = 0, max_len: int = 16) -> TextTables:
+    k = (mode, max_len)
+    if k not in _tt_cache:
+        _tt_cache[k] = TextTables(mode, max_len)
+    return _tt_cache[k]

@@ -68,6 +68,15 @@ SPECIAL_INTS = (
 
 PUNCT = b"!@#$%^&*()-+\\/:.,-'[]{}"
 
+# TextKind -> ifuzz mode (arm64 has no table: word-aligned random bytes)
+from .types import TextKind as _TK
+from ..ifuzz import MODE_LONG64 as _ML, MODE_PROT16 as _M16, \
+    MODE_PROT32 as _M32, MODE_REAL16 as _MR
+
+_TEXT_MODE = {_TK.X86_REAL: _MR, _TK.X86_16: _M16,
+              _TK.X86_32: _M32, _TK.X86_64: _ML}
+
+
 
 class RandGen:
     """Seeded random value engine for program generation/mutation."""
@@ -211,14 +220,27 @@ class RandGen:
         return bytes(buf)
 
     def generate_text(self, kind) -> bytes:
-        # x86 codegen (the reference's ifuzz) lives in ops/textgen; the host
-        # fallback emits random bytes, which the kernel treats as an
-        # arbitrary (usually faulting) instruction stream.
-        return bytes(self.intn(256) for _ in range(50))
+        """x86 machine code via the ifuzz table (reference
+        prog/rand.go:373-404 generateText -> pkg/ifuzz); arm64 and unknown
+        kinds fall back to word-aligned random bytes."""
+        from ..ifuzz import Config, generate
+
+        mode = _TEXT_MODE.get(kind)
+        if mode is None:
+            nwords = 4 + self.intn(12)
+            return bytes(self.intn(256) for _ in range(4 * nwords))
+        cfg = Config(length=2 + self.intn(15), mode=mode)
+        return generate(cfg, self.rng)
 
     def mutate_text(self, kind, text: bytes) -> bytes:
-        from .mutation import mutate_data
-        return mutate_data(self, bytearray(text), 40, 60)
+        from ..ifuzz import Config, mutate
+
+        mode = _TEXT_MODE.get(kind)
+        if mode is None:
+            from .mutation import mutate_data
+
+            return mutate_data(self, bytearray(text), 40, 60)
+        return mutate(Config(mode=mode), text, self.rng)
 
     # --- address allocation ---
 

@@ -126,9 +126,14 @@ class ChoiceTable:
     """Weighted next-call sampler: per-row integer prefix sums."""
 
     def __init__(self, target, prios: Optional[np.ndarray],
-                 enabled: Optional[Sequence[Syscall]] = None):
+                 enabled: Optional[Sequence] = None):
         self.target = target
-        calls = list(enabled) if enabled is not None else list(target.syscalls)
+        if enabled is not None:
+            # ids arrive from RPC/host-detection; Syscalls from local code
+            calls = [target.syscalls[c] if isinstance(c, int) else c
+                     for c in enabled]
+        else:
+            calls = list(target.syscalls)
         self.enabled_calls = calls
         self._enabled_ids = {c.id for c in calls}
         n = len(target.syscalls)

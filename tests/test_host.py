@@ -1,0 +1,71 @@
+"""Supported-syscall detection tests (reference pkg/host/host_linux.go)."""
+
+import os
+
+import pytest
+
+from syzkaller_tpu import host
+from syzkaller_tpu.prog import get_target
+
+
+@pytest.fixture(scope="module")
+def target():
+    return get_target("linux", "amd64")
+
+
+def _fake_kallsyms(names):
+    return b"".join(b"ffffffff81000000 T sys_%s\n" % n.encode()
+                    for n in names)
+
+
+def test_kallsyms_probe(target):
+    ks = _fake_kallsyms(["open", "close", "read"])
+    by_name = {m.name: m for m in target.syscalls}
+    assert host.is_supported(ks, by_name["open"])
+    assert host.is_supported(ks, by_name["close"])
+    assert not host.is_supported(ks, by_name["mmap"])
+    # variants share the base call's symbol
+    assert host.is_supported(ks, by_name["open$dir"])
+
+
+def test_empty_kallsyms_means_all(target):
+    by_name = {m.name: m for m in target.syscalls}
+    assert host.is_supported(b"", by_name["mmap"])
+
+
+def test_modern_symbol_prefix(target):
+    by_name = {m.name: m for m in target.syscalls}
+    ks = b"ffffffff81000000 T __x64_sys_mmap\n"
+    assert host.is_supported(ks, by_name["mmap"])
+
+
+def test_socket_probe(target):
+    by_name = {m.name: m for m in target.syscalls}
+    # AF_UNIX and AF_INET exist everywhere this test runs
+    assert host.is_supported(b"", by_name["socket$unix"])
+    assert host.is_supported(b"", by_name["socket$tcp"])
+
+
+def test_live_detection_sane(target):
+    """On the live machine a healthy majority of the corpus must probe as
+    supported, and the ctor closure must keep resource chains intact."""
+    supported = host.detect_supported_syscalls(target)
+    n_ok = sum(supported.values())
+    assert n_ok > len(target.syscalls) // 2
+    ids = host.build_call_list(target)
+    assert ids
+    names = {target.syscalls[i].name for i in ids}
+    # closure property: every enabled resource consumer has a ctor enabled
+    if "close" in names:
+        assert any(n.startswith("open") or n.startswith("socket")
+                   or n == "dup" for n in names)
+
+
+def test_transitive_pruning(target):
+    """A consumer whose only ctor is unsupported gets pruned."""
+    # enable only close (consumes fd) with no fd producer
+    by_name = {m.name: m for m in target.syscalls}
+    ids = host.build_call_list(
+        target, enabled=[by_name["close"].id],
+        kallsyms=_fake_kallsyms(["close"]))
+    assert by_name["close"].id not in ids

@@ -86,9 +86,9 @@ def test_state_more_backpressure(tmp_path):
         # distinct single-call programs; one sync per add gives distinct seqs
         st.sync("a", add=[f"open(0x{i:x}, 0x0, 0x0)\n"], del_=[])
     got1, more1 = st.sync("b", add=[], del_=[])
-    # the cap rounds up to a whole seq group (state.go:292-303), so the
-    # first page is MAX_SYNC_RECORDS + the boundary group
-    assert MAX_SYNC_RECORDS <= len(got1) <= MAX_SYNC_RECORDS + 1
+    # pages are exactly MAX records when seqs are unique (group rounding
+    # only extends through ties of the last included seq)
+    assert len(got1) == MAX_SYNC_RECORDS
     assert more1 == n - len(got1)
     got2, more2 = st.sync("b", add=[], del_=[])
     assert len(got2) == more1 and more2 == 0
