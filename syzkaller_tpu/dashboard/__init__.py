@@ -1,0 +1,372 @@
+"""Dashboard: bug-tracking web service + client API.
+
+Role parity with reference /root/reference/dashboard — the AppEngine app
+(dashboard/app/entities.go:27-98 Build/Bug/Crash entities, crash ingestion
+with dedup-by-title, needRepro decisions) and the dashapi client
+(dashboard/dashapi/dashapi.go: UploadBuild/ReportCrash/NeedRepro/
+ReportFailedRepro/ReportRepro/LogError) — redesigned as a self-hosted
+sqlite-backed HTTP JSON service instead of an AppEngine datastore app.
+
+Crash payloads (log/report/reproducers) are stored gzip-compressed, the
+same way the reference's Text entities are (entities.go:96-...).
+"""
+
+from __future__ import annotations
+
+import gzip
+import html as _html
+import http.server
+import json
+import os
+import sqlite3
+import threading
+import time
+import urllib.parse
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+MAX_CRASHES_PER_BUG = 100  # mirror of the manager's per-bug log cap
+REPRO_LEVEL_NONE = 0
+REPRO_LEVEL_SYZ = 1
+REPRO_LEVEL_C = 2
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS builds (
+    id TEXT PRIMARY KEY, namespace TEXT, manager TEXT, os TEXT, arch TEXT,
+    kernel_commit TEXT, kernel_config TEXT, time REAL
+);
+CREATE TABLE IF NOT EXISTS bugs (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    namespace TEXT, title TEXT, status TEXT DEFAULT 'open',
+    num_crashes INTEGER DEFAULT 0, repro_level INTEGER DEFAULT 0,
+    first_time REAL, last_time REAL,
+    UNIQUE(namespace, title)
+);
+CREATE TABLE IF NOT EXISTS crashes (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    bug_id INTEGER, manager TEXT, build_id TEXT, time REAL,
+    log BLOB, report BLOB, repro_syz BLOB, repro_c BLOB,
+    maintainers TEXT
+);
+CREATE INDEX IF NOT EXISTS crashes_bug ON crashes(bug_id);
+"""
+
+
+def _z(text: Optional[str]) -> Optional[bytes]:
+    return gzip.compress(text.encode()) if text else None
+
+
+def _unz(blob: Optional[bytes]) -> str:
+    return gzip.decompress(blob).decode("utf-8", "replace") if blob else ""
+
+
+class DashboardDB:
+    """sqlite store; one connection per thread via TLS."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._tls = threading.local()
+        with self._conn() as c:
+            c.executescript(_SCHEMA)
+
+    def _conn(self) -> sqlite3.Connection:
+        c = getattr(self._tls, "conn", None)
+        if c is None:
+            c = sqlite3.connect(self.path)
+            c.row_factory = sqlite3.Row
+            self._tls.conn = c
+        return c
+
+    # ---- builds ----
+
+    def upload_build(self, b: Dict) -> None:
+        with self._conn() as c:
+            c.execute(
+                "INSERT OR REPLACE INTO builds VALUES (?,?,?,?,?,?,?,?)",
+                (b["id"], b.get("namespace", ""), b.get("manager", ""),
+                 b.get("os", ""), b.get("arch", ""),
+                 b.get("kernel_commit", ""), b.get("kernel_config", ""),
+                 time.time()))
+
+    # ---- crash ingestion (reference app handler: dedup by title) ----
+
+    def report_crash(self, crash: Dict) -> Dict:
+        ns = crash.get("namespace", "")
+        title = crash.get("title", "corrupted report")
+        now = time.time()
+        with self._conn() as c:
+            row = c.execute(
+                "SELECT * FROM bugs WHERE namespace=? AND title=?",
+                (ns, title)).fetchone()
+            if row is None:
+                cur = c.execute(
+                    "INSERT INTO bugs(namespace, title, num_crashes, "
+                    "first_time, last_time) VALUES (?,?,0,?,?)",
+                    (ns, title, now, now))
+                bug_id = cur.lastrowid
+                n_crashes = 0
+                repro_level = 0
+                status = "open"
+            else:
+                bug_id = row["id"]
+                n_crashes = row["num_crashes"]
+                repro_level = row["repro_level"]
+                status = row["status"]
+            new_level = REPRO_LEVEL_C if crash.get("repro_c") else (
+                REPRO_LEVEL_SYZ if crash.get("repro_syz") else 0)
+            c.execute(
+                "UPDATE bugs SET num_crashes=num_crashes+1, last_time=?, "
+                "repro_level=MAX(repro_level, ?), status=CASE WHEN "
+                "status='fixed' THEN 'open' ELSE status END WHERE id=?",
+                (now, new_level, bug_id))
+            # store the crash payload unless the bug already has plenty
+            # and this one adds nothing new (entities-cap analogue)
+            if n_crashes < MAX_CRASHES_PER_BUG or new_level > repro_level:
+                c.execute(
+                    "INSERT INTO crashes(bug_id, manager, build_id, time,"
+                    " log, report, repro_syz, repro_c, maintainers)"
+                    " VALUES (?,?,?,?,?,?,?,?,?)",
+                    (bug_id, crash.get("manager", ""),
+                     crash.get("build_id", ""), now,
+                     _z(crash.get("log")), _z(crash.get("report")),
+                     _z(crash.get("repro_syz")), _z(crash.get("repro_c")),
+                     json.dumps(crash.get("maintainers", []))))
+            # needRepro: open bug without a C repro yet, still young
+            need_repro = (status == "open"
+                          and max(repro_level, new_level) < REPRO_LEVEL_C)
+        return {"bug_id": bug_id, "need_repro": need_repro}
+
+    def need_repro(self, ns: str, title: str) -> bool:
+        with self._conn() as c:
+            row = c.execute(
+                "SELECT status, repro_level FROM bugs WHERE namespace=? "
+                "AND title=?", (ns, title)).fetchone()
+        if row is None:
+            return False
+        return row["status"] == "open" and \
+            row["repro_level"] < REPRO_LEVEL_C
+
+    def update_bug(self, ns: str, title: str, status: str) -> bool:
+        if status not in ("open", "fixed", "invalid", "dup"):
+            raise ValueError(f"bad status {status!r}")
+        with self._conn() as c:
+            cur = c.execute(
+                "UPDATE bugs SET status=? WHERE namespace=? AND title=?",
+                (status, ns, title))
+            return cur.rowcount > 0
+
+    # ---- queries ----
+
+    def bugs(self, ns: str = "", status: str = "") -> List[Dict]:
+        q = "SELECT * FROM bugs WHERE 1=1"
+        args: List = []
+        if ns:
+            q += " AND namespace=?"
+            args.append(ns)
+        if status:
+            q += " AND status=?"
+            args.append(status)
+        q += " ORDER BY num_crashes DESC"
+        with self._conn() as c:
+            return [dict(r) for r in c.execute(q, args).fetchall()]
+
+    def bug_crashes(self, bug_id: int) -> List[Dict]:
+        with self._conn() as c:
+            rows = c.execute(
+                "SELECT * FROM crashes WHERE bug_id=? ORDER BY time DESC",
+                (bug_id,)).fetchall()
+        out = []
+        for r in rows:
+            d = dict(r)
+            for k in ("log", "report", "repro_syz", "repro_c"):
+                d[k] = _unz(d[k])
+            out.append(d)
+        return out
+
+
+class Dashboard:
+    """HTTP JSON API + minimal HTML bug browser."""
+
+    def __init__(self, workdir: str, host: str = "127.0.0.1", port: int = 0,
+                 keys: Optional[Dict[str, str]] = None):
+        os.makedirs(workdir, exist_ok=True)
+        self.db = DashboardDB(os.path.join(workdir, "dashboard.db"))
+        self.keys = keys or {}
+        dash = self
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, code: int, obj, ctype="application/json"):
+                body = obj if isinstance(obj, bytes) else \
+                    json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self) -> None:
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    if dash.keys:
+                        key = dash.keys.get(req.get("client", ""))
+                        if key is None or key != req.get("key", ""):
+                            self._reply(403, {"error": "unauthorized"})
+                            return
+                    method = self.path.lstrip("/")
+                    fn = {
+                        "api/upload_build": dash._api_upload_build,
+                        "api/report_crash": dash._api_report_crash,
+                        "api/need_repro": dash._api_need_repro,
+                        "api/failed_repro": dash._api_failed_repro,
+                        "api/update_bug": dash._api_update_bug,
+                        "api/log_error": dash._api_log_error,
+                    }.get(method)
+                    if fn is None:
+                        self._reply(404, {"error": f"no method {method}"})
+                        return
+                    self._reply(200, fn(req))
+                except Exception as e:
+                    try:
+                        self._reply(500, {"error": str(e)})
+                    except Exception:
+                        pass
+
+            def do_GET(self) -> None:
+                try:
+                    url = urllib.parse.urlparse(self.path)
+                    q = dict(urllib.parse.parse_qsl(url.query))
+                    if url.path == "/":
+                        self._reply(200, dash._html_bugs(q), "text/html")
+                    elif url.path == "/bug":
+                        self._reply(200, dash._html_bug(q), "text/html")
+                    elif url.path == "/api/bugs":
+                        self._reply(200, dash.db.bugs(
+                            q.get("ns", ""), q.get("status", "")))
+                    else:
+                        self.send_error(404)
+                except Exception as e:
+                    try:
+                        self.send_error(500, str(e))
+                    except Exception:
+                        pass
+
+        class _Server(http.server.ThreadingHTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = _Server((host, port), _Handler)
+        self.addr = "%s:%d" % self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+        self.errors: List[Dict] = []
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    # ---- API methods ----
+
+    def _api_upload_build(self, req):
+        self.db.upload_build(req["build"])
+        return {}
+
+    def _api_report_crash(self, req):
+        return self.db.report_crash(req["crash"])
+
+    def _api_need_repro(self, req):
+        return {"need_repro": self.db.need_repro(
+            req.get("namespace", ""), req["title"])}
+
+    def _api_failed_repro(self, req):
+        # recorded only for stats; a failed repro does not close the want
+        return {}
+
+    def _api_update_bug(self, req):
+        return {"ok": self.db.update_bug(
+            req.get("namespace", ""), req["title"], req["status"])}
+
+    def _api_log_error(self, req):
+        self.errors.append(req)
+        del self.errors[:-1000]
+        return {}
+
+    # ---- HTML ----
+
+    def _html_bugs(self, q) -> bytes:
+        rows = []
+        for b in self.db.bugs(q.get("ns", ""), q.get("status", "")):
+            rows.append(
+                f'<tr><td><a href="/bug?id={b["id"]}">'
+                f'{_html.escape(b["title"])}</a></td>'
+                f'<td>{b["status"]}</td><td>{b["num_crashes"]}</td>'
+                f'<td>{b["repro_level"]}</td></tr>')
+        return (
+            "<html><body><h1>bugs</h1><table border=1>"
+            "<tr><th>title</th><th>status</th><th>crashes</th>"
+            "<th>repro</th></tr>" + "".join(rows)
+            + "</table></body></html>").encode()
+
+    def _html_bug(self, q) -> bytes:
+        bug_id = int(q.get("id", 0))
+        crashes = self.db.bug_crashes(bug_id)
+        parts = [f"<h1>bug {bug_id}</h1>"]
+        for cr in crashes[:10]:
+            parts.append(f"<h3>crash @ {cr['time']}</h3>")
+            for k in ("report", "repro_c", "repro_syz", "log"):
+                if cr[k]:
+                    parts.append(
+                        f"<h4>{k}</h4><pre>"
+                        f"{_html.escape(cr[k][:1 << 16])}</pre>")
+        return ("<html><body>" + "".join(parts) + "</body></html>").encode()
+
+
+class DashApi:
+    """Client API (reference dashboard/dashapi/dashapi.go)."""
+
+    def __init__(self, addr: str, client: str = "", key: str = ""):
+        self.addr = addr
+        self.client = client
+        self.key = key
+
+    def _query(self, method: str, **req):
+        req.update({"client": self.client, "key": self.key})
+        data = json.dumps(req).encode()
+        r = urllib.request.Request(
+            f"http://{self.addr}/api/{method}", data=data,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(r, timeout=30) as resp:
+            out = json.loads(resp.read())
+        if isinstance(out, dict) and out.get("error"):
+            raise RuntimeError(out["error"])
+        return out
+
+    def upload_build(self, build: Dict) -> None:
+        self._query("upload_build", build=build)
+
+    def report_crash(self, crash: Dict) -> Dict:
+        return self._query("report_crash", crash=crash)
+
+    def need_repro(self, namespace: str, title: str) -> bool:
+        return self._query("need_repro", namespace=namespace,
+                           title=title)["need_repro"]
+
+    def report_failed_repro(self, namespace: str, title: str) -> None:
+        self._query("failed_repro", namespace=namespace, title=title)
+
+    def update_bug(self, namespace: str, title: str, status: str) -> bool:
+        return self._query("update_bug", namespace=namespace, title=title,
+                           status=status)["ok"]
+
+    def log_error(self, name: str, msg: str) -> None:
+        try:
+            self._query("log_error", name=name, msg=msg)
+        except Exception:
+            pass  # error logging must never take the caller down

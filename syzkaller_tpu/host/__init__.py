@@ -84,8 +84,16 @@ def _supported_syz(meta: Syscall) -> bool:
             return False
         if "#" not in fname:
             return os.path.exists(fname)
-        return any(os.path.exists(fname.replace("#", str(i)))
-                   for i in range(5))
+        # substitute one '#' at a time over 0-9 (host_linux.go:77-98);
+        # a device present only at index 5-9 must still enable the call
+        def check(dev: str) -> bool:
+            i = dev.find("#")
+            if i < 0:
+                return os.path.exists(dev)
+            return any(check(dev[:i] + str(d) + dev[i + 1:])
+                       for d in range(10))
+
+        return check(fname)
     if cn == "syz_open_pts":
         return os.path.exists("/dev/ptmx")
     if cn == "syz_kvm_setup_cpu":

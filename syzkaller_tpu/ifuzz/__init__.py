@@ -265,11 +265,15 @@ def encode_insn(insn: Insn, cfg: Config, rng: random.Random) -> bytes:
                 elif mod == 2 or (mod == 0 and rm == 6):
                     out += _gen_imm(rng, 2)
             else:
+                sib_base5 = False
                 if rm == 4:  # SIB
-                    out.append(rng.getrandbits(8))
+                    sib = rng.getrandbits(8)
+                    out.append(sib)
+                    # SIB base=101 with mod=00 implies a disp32
+                    sib_base5 = (sib & 7) == 5
                 if mod == 1:
                     out += _gen_imm(rng, 1)
-                elif mod == 2 or (mod == 0 and rm == 5):
+                elif mod == 2 or (mod == 0 and (rm == 5 or sib_base5)):
                     out += _gen_imm(rng, 4)
     sz = _imm_size(insn, cfg)
     if sz:
@@ -352,11 +356,15 @@ def decode(cfg: Config, data: bytes) -> int:
                 elif mod == 2 or (mod == 0 and rm == 6):
                     p += 2
             else:
+                sib_base5 = False
                 if mod != 3 and rm == 4:
+                    if p >= n:
+                        continue
+                    sib_base5 = (data[p] & 7) == 5
                     p += 1
                 if mod == 1:
                     p += 1
-                elif mod == 2 or (mod == 0 and rm == 5):
+                elif mod == 2 or (mod == 0 and (rm == 5 or sib_base5)):
                     p += 4
         p += _imm_size(insn, cfg)
         if p <= n and p > best:

@@ -51,6 +51,9 @@ class ManagerConfig:
     hub_addr: str = ""
     hub_key: str = ""
     kernel_obj: str = ""  # vmlinux path for the /cover symbolized report
+    dashboard_addr: str = ""
+    dashboard_client: str = ""
+    dashboard_key: str = ""
     ignores: List[str] = field(default_factory=list)
     suppressions: List[str] = field(default_factory=list)
     vm: VMConfig = field(default_factory=VMConfig)
@@ -117,6 +120,14 @@ class Manager:
             self._bench_thread = threading.Thread(
                 target=self._bench_loop, daemon=True)
             self._bench_thread.start()
+
+        # dashboard reporting (reference saveCrash manager.go:570-607)
+        self._dash = None
+        if cfg.dashboard_addr:
+            from ..dashboard import DashApi
+
+            self._dash = DashApi(cfg.dashboard_addr, cfg.dashboard_client,
+                                 cfg.dashboard_key)
 
         # hub federation (reference manager.go:303-310, 994-...)
         self._hub = None
@@ -273,6 +284,21 @@ class Manager:
 
     def save_crash(self, report, output: bytes, vm_index: int = -1) -> str:
         title = report.title if report else "lost connection"
+        if self._dash is not None:
+            try:
+                self._dash.report_crash({
+                    "namespace": self.cfg.name,
+                    "manager": self.cfg.name,
+                    "title": title,
+                    "log": output.decode("utf-8", "replace"),
+                    "report": report.report if report else "",
+                    "maintainers": list(getattr(report, "maintainers", [])),
+                })
+            except Exception as e:
+                from ..utils import log
+
+                log.logf(0, "dashboard report failed: %s", e)
+                self._bump("dashboard_errors")
         h = hash_str(title.encode())[:16]
         d = os.path.join(self.crashdir, h)
         os.makedirs(d, exist_ok=True)
@@ -293,6 +319,54 @@ class Manager:
                 f.write(report.report)
         self._bump("crashes")
         return d
+
+    def save_repro(self, title: str, prog_text: str,
+                   c_src: str = "") -> str:
+        """Persist a reproducer next to its crash logs (reference
+        saveRepro manager.go:682-754: repro.prog / repro.cprog); also
+        reported to the dashboard when configured.  need_repro keys off
+        the repro.prog file this writes."""
+        h = hash_str(title.encode())[:16]
+        d = os.path.join(self.crashdir, h)
+        os.makedirs(d, exist_ok=True)
+        desc = os.path.join(d, "description")
+        if not os.path.exists(desc):
+            with open(desc, "w") as f:
+                f.write(title + "\n")
+        with open(os.path.join(d, "repro.prog"), "w") as f:
+            f.write(prog_text)
+        if c_src:
+            with open(os.path.join(d, "repro.cprog"), "w") as f:
+                f.write(c_src)
+        if self._dash is not None:
+            try:
+                self._dash.report_crash({
+                    "namespace": self.cfg.name,
+                    "manager": self.cfg.name,
+                    "title": title,
+                    "repro_syz": prog_text,
+                    "repro_c": c_src,
+                })
+            except Exception as e:
+                from ..utils import log
+
+                log.logf(0, "dashboard repro report failed: %s", e)
+                self._bump("dashboard_errors")
+        self._bump("repros")
+        return d
+
+    def need_repro(self, title: str) -> bool:
+        """Whether a crash deserves a repro attempt: ask the dashboard
+        when configured, else local heuristic — no repro on disk yet
+        (reference needRepro manager.go:641-...)."""
+        if self._dash is not None:
+            try:
+                return self._dash.need_repro(self.cfg.name, title)
+            except Exception:
+                return False
+        h = hash_str(title.encode())[:16]
+        return not os.path.exists(
+            os.path.join(self.crashdir, h, "repro.prog"))
 
     # ---- stats / bench ----
 

@@ -139,6 +139,9 @@ class ChoiceTable:
         n = len(target.syscalls)
         if prios is None:
             prios = np.ones((n, n), dtype=np.float32)
+        else:
+            # RPC delivers prios as a JSON list-of-lists
+            prios = np.asarray(prios, dtype=np.float32)
         mask = np.zeros(n, dtype=bool)
         mask[[c.id for c in calls]] = True
         weights = (prios * 1000).astype(np.int64) * mask[None, :]

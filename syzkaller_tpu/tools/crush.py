@@ -1,0 +1,83 @@
+"""Replay a crash log on N VMs in parallel, counting reproductions.
+
+Capability parity with reference /root/reference/tools/syz-crush
+(crush.go): intended for particularly elusive crashes — boot every
+instance of the pool, replay the log's programs in a loop on each, and
+report how many instances crashed and with what titles.
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures as cf
+import sys
+from collections import Counter
+
+
+def crush(target, pool, data: str, instances: int, duration: float,
+          repro_mod=None) -> Counter:
+    from .. import repro as repro_mod_default
+    from ..ipc import ExecOpts
+
+    repro_mod = repro_mod or repro_mod_default
+    from ..prog.parse import parse_log
+    from ..prog.encoding import deserialize
+
+    if "executing program" in data:
+        progs = [e.p for e in parse_log(target, data)]
+    else:
+        progs = []
+        for chunk in data.split("\n\n"):
+            if chunk.strip():
+                try:
+                    progs.append(deserialize(target, chunk))
+                except Exception:
+                    pass
+    if not progs:
+        raise SystemExit("crush: no programs parsed from the log")
+
+    titles: Counter = Counter()
+
+    def one(idx: int):
+        tester = repro_mod.VMTester(pool, instance_indexes=[idx])
+        rep = tester.test_progs(progs, ExecOpts(threaded=True,
+                                                collide=True), duration)
+        return rep.title if rep is not None else None
+
+    with cf.ThreadPoolExecutor(max_workers=instances) as ex:
+        for title in ex.map(one, range(instances)):
+            if title:
+                titles[title] += 1
+    return titles
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="syz-crush")
+    ap.add_argument("log")
+    ap.add_argument("--os", default="linux")
+    ap.add_argument("--arch", default="amd64")
+    ap.add_argument("--vm-type", default="local")
+    ap.add_argument("--instances", type=int, default=4)
+    ap.add_argument("--duration", type=float, default=60.0)
+    ap.add_argument("--kernel", default="")
+    ap.add_argument("--image", default="")
+    args = ap.parse_args(argv)
+
+    from ..prog import get_target
+    from ..vm import VMConfig, create
+
+    target = get_target(args.os, args.arch)
+    pool = create(VMConfig(type=args.vm_type, count=args.instances,
+                           kernel=args.kernel, image=args.image))
+    with open(args.log) as f:
+        data = f.read()
+    titles = crush(target, pool, data, args.instances, args.duration)
+    total = sum(titles.values())
+    print(f"crush: {total}/{args.instances} instances crashed")
+    for title, n in titles.most_common():
+        print(f"  {n}x {title}")
+    return 0 if total else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

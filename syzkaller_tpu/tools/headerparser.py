@@ -1,0 +1,140 @@
+"""Generate description-language skeletons from C header files.
+
+Capability parity with reference /root/reference/tools/syz-headerparser
+(headerparser.py + headerlib): parse struct definitions out of kernel
+headers and emit ready-to-edit description structs, flag-set stubs for
+#define groups, and a report of fields needing human typing (lengths,
+pointers).  Original implementation: a small tokenizer for the C subset
+that appears in uapi headers (no preprocessor beyond #define collection).
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_STRUCT_RE = re.compile(
+    r"struct\s+(\w+)\s*\{(.*?)\}\s*(?:__attribute__\(\(packed\)\))?\s*;",
+    re.S)
+_DEFINE_RE = re.compile(
+    r"^#define\s+([A-Z_][A-Z0-9_]*)\s+"
+    r"(0x[0-9a-fA-F]+|\d+|\(?1\s*<<\s*\d+\)?)\s*$", re.M)
+_FIELD_RE = re.compile(
+    r"""(?P<type>(?:unsigned\s+|signed\s+|struct\s+|const\s+)*[\w]+)
+        \s*(?P<ptr>\**)\s*
+        (?P<name>\w+)
+        \s*(?:\[(?P<arr>[^\]]*)\])?
+        \s*(?::\s*(?P<bits>\d+))?\s*;""", re.X)
+
+_C_TO_DESC = {
+    "__u8": "int8", "u8": "int8", "uint8_t": "int8", "char": "int8",
+    "__s8": "int8", "s8": "int8",
+    "__u16": "int16", "u16": "int16", "uint16_t": "int16",
+    "__s16": "int16", "s16": "int16", "short": "int16",
+    "__be16": "int16be", "__le16": "int16",
+    "__u32": "int32", "u32": "int32", "uint32_t": "int32",
+    "__s32": "int32", "s32": "int32", "int": "int32",
+    "__be32": "int32be", "__le32": "int32",
+    "__u64": "int64", "u64": "int64", "uint64_t": "int64",
+    "__s64": "int64", "s64": "int64",
+    "__be64": "int64be", "__le64": "int64",
+    "long": "intptr", "size_t": "intptr",
+}
+
+
+def _strip_comments(text: str) -> str:
+    text = re.sub(r"/\*.*?\*/", " ", text, flags=re.S)
+    return re.sub(r"//[^\n]*", " ", text)
+
+
+def parse_structs(text: str) -> List[Tuple[str, List[Dict]]]:
+    """[(struct_name, [field dicts])] from header text."""
+    text = _strip_comments(text)
+    out = []
+    for m in _STRUCT_RE.finditer(text):
+        name, body = m.group(1), m.group(2)
+        fields = []
+        for fm in _FIELD_RE.finditer(body):
+            base = fm.group("type").strip()
+            base = re.sub(r"\b(unsigned|signed|const)\s+", "", base).strip()
+            fields.append({
+                "name": fm.group("name"),
+                "ctype": base,
+                "ptr": bool(fm.group("ptr")),
+                "array": fm.group("arr"),
+                "bits": fm.group("bits"),
+            })
+        if fields:
+            out.append((name, fields))
+    return out
+
+
+def parse_defines(text: str) -> Dict[str, str]:
+    return {m.group(1): m.group(2)
+            for m in _DEFINE_RE.finditer(_strip_comments(text))}
+
+
+def field_to_desc(f: Dict) -> Tuple[str, bool]:
+    """(description type, needs_human) for one parsed C field."""
+    if f["ptr"]:
+        return "ptr[in, TODO]", True
+    base = _C_TO_DESC.get(f["ctype"])
+    if base is None:
+        base = f["ctype"]  # struct-by-value: keep the name
+        needs = False
+    else:
+        needs = False
+    if f["bits"]:
+        return f"{base}:{f['bits']}", needs
+    if f["array"] is not None:
+        n = f["array"].strip()
+        if n.isdigit():
+            return f"array[{base}, {n}]", needs
+        return f"array[{base}]", True  # macro-sized: human decides
+    # heuristic: *len/*size fields likely belong in len[] types
+    if re.search(r"(len|size|count)$", f["name"]):
+        return base, True
+    return base, needs
+
+
+def emit_descriptions(text: str) -> str:
+    """Description-language skeleton for all structs + defines found."""
+    out: List[str] = []
+    defines = parse_defines(text)
+    if defines:
+        groups: Dict[str, List[str]] = {}
+        for name in defines:
+            prefix = name.rsplit("_", 1)[0]
+            groups.setdefault(prefix, []).append(name)
+        for prefix, names in sorted(groups.items()):
+            if len(names) >= 2:
+                out.append(f"{prefix.lower()}_flags = " +
+                           ", ".join(sorted(names)))
+        out.append("")
+    for name, fields in parse_structs(text):
+        out.append(f"{name} {{")
+        for f in fields:
+            typ, needs = field_to_desc(f)
+            todo = "\t# TODO: check" if needs else ""
+            out.append(f"\t{f['name']}\t{typ}{todo}")
+        out.append("}")
+        out.append("")
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="syz-headerparser")
+    ap.add_argument("headers", nargs="+")
+    args = ap.parse_args(argv)
+    for path in args.headers:
+        with open(path) as f:
+            text = f.read()
+        sys.stdout.write(f"# from {path}\n")
+        sys.stdout.write(emit_descriptions(text))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,64 @@
+"""Dump a serial console (USB/serial TTY) to stdout.
+
+Capability parity with reference /root/reference/tools/syz-tty
+(syz-tty.go + vmimpl.OpenConsole): configure the port raw at 115200 and
+stream it — used to eyeball what a hardware device under test prints.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+try:
+    import termios
+except ImportError:  # non-unix: tool unsupported
+    termios = None
+
+
+def open_console(path: str, baud: int = 115200) -> int:
+    """Open + configure the tty raw; returns the fd
+    (vm/vmimpl/console.go equivalent)."""
+    fd = os.open(path, os.O_RDONLY | os.O_NOCTTY | os.O_NONBLOCK)
+    if termios is not None and os.isatty(fd):
+        attrs = termios.tcgetattr(fd)
+        speed = getattr(termios, f"B{baud}", termios.B115200)
+        # raw 8N1, no flow control
+        attrs[0] = termios.IGNPAR          # iflag
+        attrs[1] = 0                       # oflag
+        attrs[2] = (termios.CS8 | termios.CREAD | termios.CLOCAL)  # cflag
+        attrs[3] = 0                       # lflag
+        attrs[4] = speed                   # ispeed
+        attrs[5] = speed                   # ospeed
+        attrs[6][termios.VMIN] = 0
+        attrs[6][termios.VTIME] = 1        # 100ms read timeout
+        termios.tcsetattr(fd, termios.TCSANOW, attrs)
+    return fd
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="syz-tty")
+    ap.add_argument("device", help="/dev/ttyUSBx")
+    ap.add_argument("--baud", type=int, default=115200)
+    args = ap.parse_args(argv)
+    fd = open_console(args.device, args.baud)
+    import select
+
+    try:
+        while True:
+            r, _, _ = select.select([fd], [], [], 1.0)
+            if not r:
+                continue
+            data = os.read(fd, 4096)
+            if data:
+                sys.stdout.buffer.write(data)
+                sys.stdout.buffer.flush()
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        os.close(fd)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

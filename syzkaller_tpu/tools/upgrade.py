@@ -1,0 +1,89 @@
+"""Upgrade a corpus directory of serialized programs to the current
+description set.
+
+Capability parity with reference /root/reference/tools/syz-upgrade
+(upgrade.go): re-parse every program in non-strict mode (dropping calls
+or args the current descriptions no longer accept) and write back the
+normalized serialization; unparseable programs are deleted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def upgrade_dir(target, dir_: str) -> dict:
+    from ..prog.encoding import deserialize, serialize
+
+    stats = {"ok": 0, "fixed": 0, "dropped": 0}
+    for name in sorted(os.listdir(dir_)):
+        path = os.path.join(dir_, name)
+        if not os.path.isfile(path):
+            continue
+        with open(path) as f:
+            text = f.read()
+        out = _reparse(target, text)
+        if out is None:
+            os.unlink(path)
+            stats["dropped"] += 1
+            continue
+        if out != text:
+            with open(path, "w") as f:
+                f.write(out)
+            stats["fixed"] += 1
+        else:
+            stats["ok"] += 1
+    return stats
+
+
+def _reparse(target, text: str):
+    """Non-strict reparse: drop lines naming calls the current
+    descriptions don't know, then retry; None when nothing survives."""
+    from ..prog.encoding import deserialize, serialize
+
+    lines = text.splitlines()
+    for _ in range(len(lines) + 1):
+        try:
+            p = deserialize(target, "\n".join(lines) + "\n")
+            return serialize(p) if p.calls else None
+        except Exception as e:
+            msg = str(e)
+            if "unknown syscall" in msg:
+                known = target.syscall_map
+                kept = [ln for ln in lines
+                        if not _names_unknown_call(ln, known)]
+                if len(kept) == len(lines):
+                    return None
+                lines = kept
+                continue
+            return None
+    return None
+
+
+def _names_unknown_call(line: str, known) -> bool:
+    import re
+
+    m = re.match(r"\s*(?:r\d+\s*=\s*)?([a-zA-Z_][\w$]*)\(", line)
+    return bool(m) and m.group(1) not in known
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="syz-upgrade")
+    ap.add_argument("corpus_dir")
+    ap.add_argument("--os", default="linux")
+    ap.add_argument("--arch", default="amd64")
+    args = ap.parse_args(argv)
+
+    from ..prog import get_target
+
+    target = get_target(args.os, args.arch)
+    stats = upgrade_dir(target, args.corpus_dir)
+    print(f"upgrade: {stats['ok']} ok, {stats['fixed']} rewritten, "
+          f"{stats['dropped']} dropped")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,126 @@
+"""CI orchestration tests (reference syz-ci two-build latest/current
+semantics, broken-head resilience, restart-on-update)."""
+
+import os
+import sys
+
+import pytest
+
+from syzkaller_tpu.ci import (
+    CI,
+    BuildInfo,
+    CIManager,
+    CIManagerConfig,
+    Updater,
+    executor_build_steps,
+)
+
+
+class FakeSource:
+    """Injectable poll/build: version bumps on demand, optionally broken."""
+
+    def __init__(self):
+        self.version = "v1"
+        self.broken = False
+        self.builds = 0
+
+    def poll(self):
+        return self.version
+
+    def build(self, tag, outdir):
+        self.builds += 1
+        if self.broken:
+            raise RuntimeError("compile error")
+        with open(os.path.join(outdir, "artifact"), "w") as f:
+            f.write(tag)
+
+
+def test_updater_latest_current(tmp_path):
+    src = FakeSource()
+    up = Updater(str(tmp_path), src.poll, src.build)
+    assert up.poll_and_build() is True
+    assert BuildInfo.load(up.latest).tag == "v1"
+    # same version: no rebuild
+    assert up.poll_and_build() is False
+    assert src.builds == 1
+    # use_latest copies into current
+    assert up.use_latest().tag == "v1"
+    assert open(os.path.join(up.current, "artifact")).read() == "v1"
+    # version moves: rebuild + promote
+    src.version = "v2"
+    assert up.poll_and_build() is True
+    assert up.use_latest().tag == "v2"
+
+
+def test_broken_head_keeps_last_known_good(tmp_path):
+    src = FakeSource()
+    up = Updater(str(tmp_path), src.poll, src.build)
+    up.poll_and_build()
+    src.version = "v2"
+    src.broken = True
+    assert up.poll_and_build() is False
+    assert up.build_failures == 1
+    # latest still the good v1 build; current still usable
+    assert BuildInfo.load(up.latest).tag == "v1"
+    assert up.use_latest().tag == "v1"
+    # head fixed: recovers
+    src.broken = False
+    assert up.poll_and_build() is True
+    assert BuildInfo.load(up.latest).tag == "v2"
+
+
+def test_failed_test_step_blocks_promotion(tmp_path):
+    src = FakeSource()
+
+    def bad_test(d):
+        raise RuntimeError("selftest failed")
+
+    up = Updater(str(tmp_path), src.poll, src.build, test=bad_test)
+    assert up.poll_and_build() is False
+    assert BuildInfo.load(up.latest) is None
+    assert up.use_latest() is None
+
+
+def test_ci_manager_restart_on_update(tmp_path):
+    src = FakeSource()
+    up = Updater(str(tmp_path / "build"), src.poll, src.build)
+    # managed process: sleeps forever; uses {current} to prove expansion
+    mgr = CIManager(str(tmp_path / "m1"), CIManagerConfig(
+        name="m1",
+        argv=[sys.executable, "-c",
+              "import sys, time; open(sys.argv[1]).close(); "
+              "time.sleep(60)", "{current}/artifact"]), up)
+    ci = CI(up, [mgr], poll_period=0.1)
+    try:
+        r = ci.run_once()
+        assert r == {"updated": 1, "started": 1}
+        pid1 = mgr.proc.pid
+        assert mgr.proc.poll() is None
+        # no change: process left alone
+        assert ci.run_once() == {"updated": 0, "started": 0}
+        assert mgr.proc.pid == pid1
+        # update: restart with the new build
+        src.version = "v2"
+        r = ci.run_once()
+        assert r["updated"] == 1
+        assert mgr.proc.pid != pid1
+        # process death: next cycle resurrects it
+        mgr.proc.kill()
+        mgr.proc.wait()
+        assert ci.run_once() == {"updated": 0, "started": 1}
+        assert mgr.proc.poll() is None
+    finally:
+        ci.stop()
+
+
+def test_executor_build_steps(tmp_path):
+    import pathlib
+
+    repo = str(pathlib.Path(__file__).resolve().parent.parent)
+    poll, build, test = executor_build_steps(repo)
+    up = Updater(str(tmp_path), poll, build, test)
+    assert up.poll_and_build() is True
+    exe = os.path.join(up.latest, "syz-executor")
+    assert os.path.isfile(exe) and os.access(exe, os.X_OK)
+    # second poll: mtime fingerprint unchanged -> no rebuild
+    assert up.poll_and_build() is False

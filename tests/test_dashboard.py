@@ -1,0 +1,131 @@
+"""Dashboard service + dashapi client tests (reference dashboard/app
+crash-ingestion semantics: dedup by title, needRepro, bug lifecycle)."""
+
+import urllib.request
+
+import pytest
+
+from syzkaller_tpu.dashboard import (
+    Dashboard,
+    DashApi,
+    REPRO_LEVEL_C,
+)
+
+
+@pytest.fixture()
+def dash(tmp_path):
+    d = Dashboard(str(tmp_path), keys={"mgr": "k"})
+    d.start()
+    yield d
+    d.stop()
+
+
+@pytest.fixture()
+def api(dash):
+    return DashApi(dash.addr, "mgr", "k")
+
+
+def test_auth(dash):
+    bad = DashApi(dash.addr, "mgr", "wrong")
+    with pytest.raises(Exception):
+        bad.report_crash({"title": "x"})
+
+
+def test_crash_dedup_by_title(api, dash):
+    for i in range(5):
+        r = api.report_crash({
+            "namespace": "ns", "manager": "mgr",
+            "title": "KASAN: use-after-free in foo",
+            "log": f"log {i}", "report": "trace"})
+    bugs = dash.db.bugs("ns")
+    assert len(bugs) == 1
+    assert bugs[0]["num_crashes"] == 5
+    assert r["need_repro"] is True
+    crashes = dash.db.bug_crashes(bugs[0]["id"])
+    assert len(crashes) == 5
+    assert crashes[0]["log"].startswith("log")
+
+
+def test_need_repro_lifecycle(api, dash):
+    title = "WARNING in bar"
+    api.report_crash({"namespace": "ns", "title": title, "log": "l"})
+    assert api.need_repro("ns", title)
+    # C repro arrives -> no more repro wanted
+    api.report_crash({"namespace": "ns", "title": title, "log": "l",
+                      "repro_c": "int main() {}"})
+    assert not api.need_repro("ns", title)
+    bugs = dash.db.bugs("ns")
+    assert bugs[0]["repro_level"] == REPRO_LEVEL_C
+    # unknown bug: no repro wanted
+    assert not api.need_repro("ns", "no such bug")
+
+
+def test_bug_status_updates_and_reopen(api, dash):
+    title = "BUG: unable to handle kernel paging request in baz"
+    api.report_crash({"namespace": "ns", "title": title, "log": "l"})
+    assert api.update_bug("ns", title, "fixed")
+    assert dash.db.bugs("ns", "fixed")
+    # crash comes back after the fix -> bug reopens (regression handling)
+    api.report_crash({"namespace": "ns", "title": title, "log": "l"})
+    assert dash.db.bugs("ns", "open")
+    assert not api.update_bug("ns", "missing title", "fixed")
+    with pytest.raises(Exception):
+        api.update_bug("ns", title, "bogus-status")
+
+
+def test_build_upload_and_html(api, dash):
+    api.upload_build({"id": "b1", "namespace": "ns", "manager": "mgr",
+                      "os": "linux", "arch": "amd64",
+                      "kernel_commit": "deadbeef"})
+    api.report_crash({"namespace": "ns", "title": "t", "log": "l",
+                      "build_id": "b1"})
+    page = urllib.request.urlopen(
+        f"http://{dash.addr}/", timeout=10).read().decode()
+    assert "t" in page and "bugs" in page
+    bug_id = dash.db.bugs("ns")[0]["id"]
+    detail = urllib.request.urlopen(
+        f"http://{dash.addr}/bug?id={bug_id}", timeout=10).read().decode()
+    assert "crash @" in detail
+
+
+def test_manager_reports_to_dashboard(dash, tmp_path):
+    from syzkaller_tpu.manager import Manager, ManagerConfig
+    from syzkaller_tpu.prog import get_target
+
+    m = Manager(ManagerConfig(
+        name="ns", workdir=str(tmp_path / "wd"),
+        dashboard_addr=dash.addr, dashboard_client="mgr",
+        dashboard_key="k"), target=get_target("linux", "amd64"))
+    try:
+        class R:
+            title = "KASAN: slab-out-of-bounds in qux"
+            report = "trace"
+            maintainers = ["a@k.org"]
+
+        m.save_crash(R(), b"console log", 0)
+        bugs = dash.db.bugs("ns")
+        assert len(bugs) == 1 and bugs[0]["title"] == R.title
+        assert m.need_repro(R.title)  # dashboard-driven decision
+    finally:
+        m.close()
+
+
+def test_save_repro_and_local_need_repro(tmp_path):
+    """Without a dashboard the repro.prog file gates need_repro."""
+    from syzkaller_tpu.manager import Manager, ManagerConfig
+    from syzkaller_tpu.prog import get_target
+
+    m = Manager(ManagerConfig(workdir=str(tmp_path / "wd")),
+                target=get_target("linux", "amd64"))
+    try:
+        title = "WARNING in quux"
+        assert m.need_repro(title)
+        d = m.save_repro(title, "close(0xffffffffffffffff)\n",
+                         "int main() { return 0; }")
+        import os
+
+        assert os.path.exists(os.path.join(d, "repro.prog"))
+        assert os.path.exists(os.path.join(d, "repro.cprog"))
+        assert not m.need_repro(title)
+    finally:
+        m.close()
