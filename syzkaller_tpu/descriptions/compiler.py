@@ -55,7 +55,22 @@ from .ast import (
     TypeExpr,
 )
 
-PSEUDO_NR_BASE = 1 << 30  # syz_* pseudo-syscalls, dispatched by name
+PSEUDO_NR_BASE = 1 << 30  # syz_* pseudo-syscalls
+
+# Fixed pseudo-syscall ids, mirrored by the executor's execute_pseudo
+# dispatch (executor.cc kSyz* constants).  Fixed (not appearance-ordered)
+# so description reshuffles can't silently retarget the C++ side.
+PSEUDO_IDS = {
+    "syz_open_dev": 0,
+    "syz_open_pts": 1,
+    "syz_emit_ethernet": 2,
+    "syz_extract_tcp_res": 3,
+    "syz_fuse_mount": 4,
+    "syz_fusectl_mount": 5,
+    "syz_kvm_setup_cpu": 6,
+    "syz_test": 7,
+}
+_PSEUDO_DYN_BASE = 64  # unknown syz_* calls: stable sorted allocation
 
 _INT_SIZES = {"int8": 1, "int16": 2, "int32": 4, "int64": 8,
               "int16be": 2, "int32be": 4, "int64be": 8}
@@ -107,7 +122,9 @@ class Compiler:
             resources.append(self._resource_desc(name))
 
         syscalls: List[Syscall] = []
-        pseudo_idx = 0
+        dyn_pseudo = sorted({cd.call_name for cd in self.calls
+                             if cd.call_name.startswith("syz_")
+                             and cd.call_name not in PSEUDO_IDS})
         for cd in self.calls:
             try:
                 args = tuple(
@@ -123,8 +140,10 @@ class Compiler:
                 self.unsupported.append(f"{cd.name}: {e}")
                 continue
             if cd.call_name.startswith("syz_"):
-                nr = PSEUDO_NR_BASE + pseudo_idx
-                pseudo_idx += 1
+                pid = PSEUDO_IDS.get(cd.call_name)
+                if pid is None:
+                    pid = _PSEUDO_DYN_BASE + dyn_pseudo.index(cd.call_name)
+                nr = PSEUDO_NR_BASE + pid
             else:
                 nr = self.consts.get(f"__NR_{cd.call_name}")
                 if nr is None:

@@ -53,6 +53,8 @@ class FuzzerConfig:
     device_period: int = 16             # consume a device batch every N steps
     env_config: Optional[EnvConfig] = None
     detect_supported: bool = False      # probe the live machine (pkg/host)
+    leak_check: bool = False            # kmemleak scan every leak_period
+    leak_period: int = 1000             # executions between scans
 
 
 class ManagerConn:
@@ -119,6 +121,14 @@ class Fuzzer:
             else:
                 ec = self.cfg.env_config or EnvConfig(sandbox=self.cfg.sandbox)
                 self.envs.append(Env(target, pid=pid, config=ec))
+
+        self._leak = None
+        self.leak_reports = []
+        self._next_leak_scan = self.cfg.leak_period
+        if self.cfg.leak_check:
+            from .kmemleak import Kmemleak
+
+            self._leak = Kmemleak()
 
         self._device = None
         if self.cfg.use_device:
@@ -356,6 +366,16 @@ class Fuzzer:
                 break
             self.step()
             i += 1
+            if self._leak is not None and \
+                    self.stats["exec_total"] >= self._next_leak_scan:
+                self._next_leak_scan = self.stats["exec_total"] + \
+                    self.cfg.leak_period
+                leaks = self._leak.scan()
+                if leaks:
+                    self.leak_reports.extend(leaks)
+                    del self.leak_reports[:-100]
+                    self.stats["leaks"] = self.stats.get("leaks", 0) + \
+                        len(leaks)
 
     def poll_manager(self) -> None:
         """Exchange stats/new-signal with the manager (fuzzer.go:334-427)."""

@@ -1,0 +1,67 @@
+"""Kernel memory-leak detection via /sys/kernel/debug/kmemleak.
+
+Role parity with reference /root/reference/syz-fuzzer/kmemleak.go
+(+fuzzer.go:219,235-243): trigger a scan after a batch of executions,
+read back leak records, clear.  The first scan's findings are ignored —
+boot-time allocations dominate it (the reference does the same).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+KMEMLEAK_PATH = "/sys/kernel/debug/kmemleak"
+
+
+class Kmemleak:
+    def __init__(self, path: str = KMEMLEAK_PATH):
+        self.path = path
+        self._first = True
+        self.available = self._probe()
+
+    def _probe(self) -> bool:
+        try:
+            with open(self.path, "rb"):
+                return True
+        except OSError:
+            return False
+
+    def scan(self, settle: float = 0.0) -> List[str]:
+        """Trigger a scan; returns the list of leak records (text blocks).
+        Boot-time noise from the first scan is discarded."""
+        if not self.available:
+            return []
+        try:
+            with open(self.path, "w") as f:
+                f.write("scan")
+            if settle:
+                time.sleep(settle)
+            with open(self.path, "r") as f:
+                data = f.read()
+            with open(self.path, "w") as f:
+                f.write("clear")
+        except OSError:
+            self.available = False
+            return []
+        if self._first:
+            self._first = False
+            return []
+        return parse_leaks(data)
+
+
+def parse_leaks(data: str) -> List[str]:
+    """Split a kmemleak report into per-leak blocks ('unreferenced
+    object ...' headers)."""
+    leaks: List[str] = []
+    cur: Optional[List[str]] = None
+    for line in data.splitlines():
+        if line.startswith("unreferenced object"):
+            if cur:
+                leaks.append("\n".join(cur))
+            cur = [line]
+        elif cur is not None:
+            cur.append(line)
+    if cur:
+        leaks.append("\n".join(cur))
+    return leaks

@@ -42,8 +42,16 @@
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
+#include <arpa/inet.h>
+#include <linux/if.h>
+#include <linux/if_tun.h>
+#include <linux/kvm.h>
+#include <net/if_arp.h>
+#include <netinet/in.h>
 #include <sys/ioctl.h>
 #include <sys/mman.h>
+#include <sys/mount.h>
+#include <sys/socket.h>
 #include <sys/prctl.h>
 #include <sys/resource.h>
 #include <sys/stat.h>
@@ -324,12 +332,278 @@ static bool fault_injection_check(thread_t* th) {
   return r > 0 && atoi(buf) == 0;
 }
 
+// ---------------- pseudo-syscalls (syz_*) ---------------------------------
+// Fixed ids mirrored from descriptions/compiler.py PSEUDO_IDS; role parity
+// with reference executor/common_linux.h:298-660 (TUN + pseudo-syscalls)
+// and common_kvm_amd64.h (KVM vcpu setup) — reimplemented from the
+// documented kernel APIs, not translated.
+
+const uint64 kSyzOpenDev = 0;
+const uint64 kSyzOpenPts = 1;
+const uint64 kSyzEmitEthernet = 2;
+const uint64 kSyzExtractTcpRes = 3;
+const uint64 kSyzFuseMount = 4;
+const uint64 kSyzFusectlMount = 5;
+const uint64 kSyzKvmSetupCpu = 6;
+const uint64 kSyzTest = 7;
+
+// --- virtual NIC (reference initialize_tun common_linux.h:298-360) ---
+
+static int g_tun_fd = -1;
+
+static void setup_tun(int pid) {
+  // tap device per proc; packets written to the fd enter the kernel
+  // network stack as if received on the wire
+  g_tun_fd = open("/dev/net/tun", O_RDWR | O_NONBLOCK);
+  if (g_tun_fd == -1) return;
+  struct ifreq ifr;
+  memset(&ifr, 0, sizeof(ifr));
+  snprintf(ifr.ifr_name, sizeof(ifr.ifr_name), "syz%d", pid);
+  ifr.ifr_flags = IFF_TAP | IFF_NO_PI;
+  if (ioctl(g_tun_fd, TUNSETIFF, &ifr) < 0) {
+    close(g_tun_fd);
+    g_tun_fd = -1;
+    return;
+  }
+  int sk = socket(AF_INET, SOCK_DGRAM, 0);
+  if (sk < 0) return;
+  // 172.20.<pid>.1/24, up
+  struct sockaddr_in* sin = (struct sockaddr_in*)&ifr.ifr_addr;
+  sin->sin_family = AF_INET;
+  sin->sin_addr.s_addr = htonl(0xac140001 | ((uint32)pid << 8));
+  ioctl(sk, SIOCSIFADDR, &ifr);
+  ifr.ifr_flags = IFF_UP;
+  ioctl(sk, SIOCSIFFLAGS, &ifr);
+  close(sk);
+}
+
+static uint64 syz_emit_ethernet(uint64* a, int* err) {
+  // a0 = len, a1 = packet ptr
+  if (g_tun_fd == -1) {
+    *err = EBADFD;
+    return (uint64)-1;
+  }
+  uint64 len = a[0];
+  if (len > (64 << 10)) len = 64 << 10;
+  long n = -1;
+  NONFAILING(n = write(g_tun_fd, (void*)a[1], len));
+  if (n == -1) *err = errno;
+  return (uint64)n;
+}
+
+static uint64 syz_extract_tcp_res(uint64* a, int* err) {
+  // a0 = res ptr {seq, ack}, a1 = seq_inc, a2 = ack_inc: read one packet
+  // off the tap and record its TCP seq/ack (+increments) for reuse
+  if (g_tun_fd == -1) {
+    *err = EBADFD;
+    return (uint64)-1;
+  }
+  char pkt[1 << 12];
+  long n = read(g_tun_fd, pkt, sizeof(pkt));
+  if (n < (long)(14 + 20 + 20)) {
+    *err = n < 0 ? errno : EAGAIN;
+    return (uint64)-1;
+  }
+  // eth(14) + ipv4(ihl) + tcp: seq at +4, ack at +8
+  int ihl = (pkt[14] & 0xF) * 4;
+  int tcp = 14 + ihl;
+  if (tcp + 20 > n || ((pkt[14] >> 4) & 0xF) != 4) {
+    *err = EINVAL;
+    return (uint64)-1;
+  }
+  uint32 seq, ack;
+  memcpy(&seq, pkt + tcp + 4, 4);
+  memcpy(&ack, pkt + tcp + 8, 4);
+  seq = __builtin_bswap32(seq) + (uint32)a[1];
+  ack = __builtin_bswap32(ack) + (uint32)a[2];
+  NONFAILING({
+    ((uint32*)a[0])[0] = seq;
+    ((uint32*)a[0])[1] = ack;
+  });
+  return 0;
+}
+
+static uint64 syz_open_dev(uint64* a, int* err) {
+  // a0 = device path with '#' placeholder, a1 = id, a2 = flags
+  char buf[128] = {};
+  NONFAILING(strncpy(buf, (char*)a[0], sizeof(buf) - 1));
+  for (char* p = buf; *p; p++)
+    if (*p == '#') *p = '0' + (char)(a[1] % 10);
+  long fd = open(buf, (int)a[2], 0);
+  if (fd == -1) *err = errno;
+  return (uint64)fd;
+}
+
+static uint64 syz_open_pts(uint64* a, int* err) {
+  // a0 = ptmx fd, a1 = flags: open the slave end
+  int ptyno = 0;
+  if (ioctl((int)a[0], TIOCGPTN, &ptyno)) {
+    *err = errno;
+    return (uint64)-1;
+  }
+  char buf[32];
+  snprintf(buf, sizeof(buf), "/dev/pts/%d", ptyno);
+  long fd = open(buf, (int)a[1], 0);
+  if (fd == -1) *err = errno;
+  return (uint64)fd;
+}
+
+static uint64 syz_fuse_mount(uint64* a, int* err, bool fusectl) {
+  // a0 = dest path, a1 = mode, a2 = uid, a3 = gid, a4 = maxread,
+  // a5 = mount flags
+  uint64 mode = a[1], uid = a[2], gid = a[3], maxread = a[4];
+  int fd = open("/dev/fuse", O_RDWR);
+  if (fd == -1) {
+    *err = errno;
+    return (uint64)-1;
+  }
+  char opts[256];
+  int n = snprintf(opts, sizeof(opts),
+                   "fd=%d,rootmode=%o,user_id=%llu,group_id=%llu",
+                   fd, (unsigned)(mode & ~3u), (unsigned long long)uid,
+                   (unsigned long long)gid);
+  if (maxread)
+    snprintf(opts + n, sizeof(opts) - n, ",max_read=%llu",
+             (unsigned long long)maxread);
+  const char* fstype = (mode & 1) ? "fuseblk" : "fuse";
+  char dest[128] = {};
+  NONFAILING(strncpy(dest, (char*)a[0], sizeof(dest) - 1));
+  mkdir(dest, 0777);
+  long res = mount("/dev/fuse", dest, fstype, (unsigned long)a[5], opts);
+  if (res == -1) {
+    *err = errno;
+    close(fd);
+    return (uint64)-1;
+  }
+  if (fusectl) {
+    // also expose the fuse control fs (reference syz_fusectl_mount)
+    mkdir("./fusectl", 0777);
+    mount("fusectl", "./fusectl", "fusectl", 0, 0);
+  }
+  return (uint64)fd;
+}
+
+// --- KVM vcpu setup (reference common_kvm_amd64.h's role) ---
+
+#if defined(__x86_64__)
+static void kvm_setup_long_mode(void* mem, struct kvm_sregs* sregs) {
+  // identity-map the first 1GB with one PDPT 1GB page; tables at guest
+  // phys 0x2000/0x3000 (inside the usermem arena)
+  uint64* pml4 = (uint64*)((char*)mem + 0x2000);
+  uint64* pdpt = (uint64*)((char*)mem + 0x3000);
+  pml4[0] = 0x3000 | 3;            // present|write -> pdpt
+  pdpt[0] = 0x83;                  // present|write|1GB page @0
+  sregs->cr3 = 0x2000;
+  sregs->cr4 |= 1 << 5;            // PAE
+  sregs->cr0 |= (1u << 0) | (1u << 31);  // PE | PG
+  sregs->efer |= (1 << 8) | (1 << 10);   // LME | LMA
+  struct kvm_segment seg;
+  memset(&seg, 0, sizeof(seg));
+  seg.base = 0;
+  seg.limit = 0xffffffff;
+  seg.selector = 0x8;
+  seg.present = 1;
+  seg.type = 11;  // exec/read accessed
+  seg.dpl = 0;
+  seg.db = 0;
+  seg.s = 1;
+  seg.l = 1;  // 64-bit
+  seg.g = 1;
+  sregs->cs = seg;
+  seg.type = 3;  // data
+  seg.selector = 0x10;
+  seg.l = 0;
+  sregs->ds = sregs->es = sregs->ss = seg;
+}
+
+static uint64 syz_kvm_setup_cpu(uint64* a, int* err) {
+  // a0 = vm fd, a1 = vcpu fd, a2 = usermem (>= 24 pages), a3 = text ptr,
+  // a4 = text len, a5 = flags (bit0: long mode, else real mode)
+  int vmfd = (int)a[0], cpufd = (int)a[1];
+  void* mem = (void*)a[2];
+  uint64 flags = a[5];
+  const uint64 mem_size = 24 * 4096;
+
+  struct kvm_userspace_memory_region reg;
+  memset(&reg, 0, sizeof(reg));
+  reg.slot = 0;
+  reg.guest_phys_addr = 0;
+  reg.memory_size = mem_size;
+  reg.userspace_addr = (uint64)mem;
+  bool ok = false;
+  NONFAILING({
+    memset(mem, 0, mem_size);
+    ok = true;
+  });
+  if (!ok || ioctl(vmfd, KVM_SET_USER_MEMORY_REGION, &reg) < 0) {
+    *err = ok ? errno : EFAULT;
+    return (uint64)-1;
+  }
+
+  // guest code at phys 0x1000, padded with hlt
+  const uint64 code_at = 0x1000;
+  uint64 tlen = a[4];
+  if (tlen > 0x800) tlen = 0x800;
+  NONFAILING({
+    memset((char*)mem + code_at, 0xf4 /* hlt */, 0x1000);
+    memcpy((char*)mem + code_at, (void*)a[3], tlen);
+  });
+
+  struct kvm_sregs sregs;
+  if (ioctl(cpufd, KVM_GET_SREGS, &sregs) < 0) {
+    *err = errno;
+    return (uint64)-1;
+  }
+  if (flags & 1) {
+    kvm_setup_long_mode(mem, &sregs);
+  } else {
+    // real mode at 0:code_at
+    sregs.cs.base = 0;
+    sregs.cs.selector = 0;
+    sregs.cr0 &= ~1ull;  // PE off
+  }
+  if (ioctl(cpufd, KVM_SET_SREGS, &sregs) < 0) {
+    *err = errno;
+    return (uint64)-1;
+  }
+  struct kvm_regs regs;
+  memset(&regs, 0, sizeof(regs));
+  regs.rip = code_at;
+  regs.rsp = mem_size - 16;
+  regs.rflags = 2;  // reserved bit must be set
+  if (ioctl(cpufd, KVM_SET_REGS, &regs) < 0) {
+    *err = errno;
+    return (uint64)-1;
+  }
+  return 0;
+}
+#else
+static uint64 syz_kvm_setup_cpu(uint64* a, int* err) {
+  (void)a;
+  *err = ENOSYS;
+  return (uint64)-1;
+}
+#endif
+
 static uint64 execute_pseudo(uint64 nr, uint64* args, int* err) {
-  // syz_* pseudo-syscalls. The descriptions compiler assigns ids
-  // kPseudoNrBase+idx in order of first appearance; the current description
-  // set defines none, so any id is ENOSYS until implementations land here.
-  (void)nr;
-  (void)args;
+  switch (nr - kPseudoNrBase) {
+    case kSyzOpenDev:
+      return syz_open_dev(args, err);
+    case kSyzOpenPts:
+      return syz_open_pts(args, err);
+    case kSyzEmitEthernet:
+      return syz_emit_ethernet(args, err);
+    case kSyzExtractTcpRes:
+      return syz_extract_tcp_res(args, err);
+    case kSyzFuseMount:
+      return syz_fuse_mount(args, err, false);
+    case kSyzFusectlMount:
+      return syz_fuse_mount(args, err, true);
+    case kSyzKvmSetupCpu:
+      return syz_kvm_setup_cpu(args, err);
+    case kSyzTest:
+      return 0;
+  }
   *err = ENOSYS;
   return (uint64)-1;
 }
@@ -739,9 +1013,14 @@ static void do_sandbox(uint64 kind) {
     if (unshare(CLONE_NEWUSER | CLONE_NEWNS | CLONE_NEWNET) == -1)
       debug("unshare failed: %d\n", errno);
   } else if (kind == kEnvSandboxSetuid) {
+    setup_tun(g_pid);
     if (setresgid(65534, 65534, 65534) == -1) debug("setresgid failed\n");
     if (setresuid(65534, 65534, 65534) == -1) debug("setresuid failed\n");
+    return;
   }
+  // all sandboxes (incl. "none") get the virtual NIC, like the reference's
+  // initialize_tun running for every sandbox variant
+  setup_tun(g_pid);
 }
 
 // ---------------- fork server ---------------------------------------------

@@ -72,6 +72,8 @@ from .types import (
     is_pad,
 )
 
+VMA_MAX_PAGES = 64  # symmetric encode/decode clamp for SK_VMA slots
+
 REF_NONE = UINT64_MAX
 
 
@@ -323,7 +325,8 @@ def encode_prog(tables: CompiledTables, fmt: TensorFormat, p: Prog,
                             payload[: end - base], dtype=np.uint8)
             elif kind == SK_VMA:
                 npg = arg.pages_num if isinstance(arg, PointerArg) else 1
-                slot_val[ci, si] = np.uint64(max(1, npg))
+                slot_val[ci, si] = np.uint64(
+                    max(1, min(npg, VMA_MAX_PAGES)))
             # SK_PTR / SK_LEN: static / recomputed
     return out
 
@@ -387,7 +390,7 @@ def decode_prog(tables: CompiledTables, fmt: TensorFormat,
                 else:
                     arg.data = b"\x00" * n
             elif kind == SK_VMA:
-                arg.pages_num = max(1, min(v, 16))
+                arg.pages_num = max(1, min(v, VMA_MAX_PAGES))
                 arg.page_index = vma_cursor
                 vma_cursor += int(arg.pages_num)
             elif kind == SK_PTR:

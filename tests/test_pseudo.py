@@ -1,0 +1,138 @@
+"""Pseudo-syscall (syz_*) tests: stable ids, real-executor execution,
+kmemleak parsing."""
+
+import os
+
+import pytest
+
+from syzkaller_tpu.descriptions.compiler import PSEUDO_IDS, PSEUDO_NR_BASE
+from syzkaller_tpu.prog import get_target
+from syzkaller_tpu.prog.encoding import deserialize
+
+
+@pytest.fixture(scope="module")
+def target():
+    return get_target("linux", "amd64")
+
+
+def test_pseudo_ids_fixed(target):
+    """Every syz_* variant's nr comes from the fixed registry (so the
+    executor's switch stays in sync across description edits)."""
+    for s in target.syscalls:
+        if s.call_name.startswith("syz_"):
+            assert s.call_name in PSEUDO_IDS, s.call_name
+            assert s.nr == PSEUDO_NR_BASE + PSEUDO_IDS[s.call_name]
+
+
+def test_descriptions_cover_pseudo_surface(target):
+    names = {s.name for s in target.syscalls}
+    for want in ["syz_open_dev$tty", "syz_open_pts", "syz_emit_ethernet",
+                 "syz_extract_tcp_res", "syz_fuse_mount",
+                 "syz_kvm_setup_cpu", "openat$kvm", "openat$ptmx",
+                 "ioctl$KVM_CREATE_VM", "ioctl$KVM_RUN"]:
+        assert want in names, want
+
+
+def test_executor_runs_pts_chain(target, tmp_path):
+    """openat$ptmx -> syz_open_pts through the real executor: the pts
+    pseudo-call must succeed against the live /dev/ptmx."""
+    if not os.path.exists("/dev/ptmx"):
+        pytest.skip("no /dev/ptmx")
+    from syzkaller_tpu.ipc import Env, ExecOpts
+
+    # unlock the slave (TIOCSPTLCK 0) before opening it, as real pty
+    # users (and reference-generated programs) do
+    text = (
+        'r0 = openat$ptmx(0xffffffffffffff9c, '
+        '&0:0:0="/dev/ptmx\\x00", 0x2, 0x0)\n'
+        'ioctl$TIOCSPTLCK(r0, 0x40045431, &1:0:0=0x00000000)\n'
+        "syz_open_pts(r0, 0x2)\n"
+    )
+    p = deserialize(target, text)
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        with Env(target, pid=0) as env:
+            _, infos, failed, hanged = env.exec(ExecOpts(), p)
+    finally:
+        os.chdir(cwd)
+    assert not failed and not hanged
+    assert [i.errno for i in infos] == [0, 0, 0]
+
+
+def test_executor_open_dev_substitution(target, tmp_path):
+    """syz_open_dev replaces '#' with the id digit."""
+    from syzkaller_tpu.ipc import Env, ExecOpts
+
+    # /dev/tty exists everywhere; use id substitution over /dev/tty#
+    # (tty0 may not exist in a container: accept ENOENT/EACCES/EIO but
+    # crucially not EFAULT/ENOSYS, which would mean broken dispatch)
+    text = ('syz_open_dev$tty(&0:0:0="/dev/tty#\\x00", 0x0, 0x0)\n')
+    p = deserialize(target, text)
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        with Env(target, pid=0) as env:
+            _, infos, failed, hanged = env.exec(ExecOpts(), p)
+    finally:
+        os.chdir(cwd)
+    assert not failed
+    import errno as e
+
+    assert infos[0].errno in (0, e.ENOENT, e.EACCES, e.EIO, e.ENXIO)
+
+
+def test_executor_kvm_chain(target, tmp_path):
+    """KVM setup chain: with /dev/kvm the vcpu must be runnable; without,
+    the open fails cleanly (never ENOSYS from the pseudo dispatch)."""
+    from syzkaller_tpu.ipc import Env, ExecOpts
+
+    text = (
+        'r0 = openat$kvm(0xffffffffffffff9c, '
+        '&0:0:0="/dev/kvm\\x00", 0x2, 0x0)\n'
+        "r1 = ioctl$KVM_CREATE_VM(r0, 0xae01, 0x0)\n"
+        "r2 = ioctl$KVM_CREATE_VCPU(r1, 0xae41, 0x0)\n"
+        'syz_kvm_setup_cpu(r1, r2, &vma 100:24, '
+        '&1:0:0="f4f4f4f4", 0x4, 0x0)\n'
+    )
+    p = deserialize(target, text)
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        with Env(target, pid=0) as env:
+            _, infos, failed, hanged = env.exec(ExecOpts(), p)
+    finally:
+        os.chdir(cwd)
+    assert not failed
+    import errno as e
+
+    if os.path.exists("/dev/kvm") and os.access("/dev/kvm", os.W_OK):
+        assert [i.errno for i in infos] == [0, 0, 0, 0]
+    else:
+        assert infos[0].errno in (e.ENOENT, e.EACCES, e.EPERM)
+        # downstream calls see invalid fds, not a broken dispatcher
+        assert all(i.errno != e.ENOSYS for i in infos)
+
+
+def test_kmemleak_parse():
+    from syzkaller_tpu.engine.kmemleak import parse_leaks
+
+    data = """unreferenced object 0xffff8880111 (size 64):
+  comm "syz-executor", pid 1234
+  backtrace:
+    [<00000000abc>] kmalloc+0x10
+unreferenced object 0xffff8880222 (size 128):
+  comm "kworker", pid 5
+"""
+    leaks = parse_leaks(data)
+    assert len(leaks) == 2
+    assert "0xffff8880111" in leaks[0]
+    assert "kworker" in leaks[1]
+
+
+def test_kmemleak_unavailable_is_quiet(tmp_path):
+    from syzkaller_tpu.engine.kmemleak import Kmemleak
+
+    k = Kmemleak(path=str(tmp_path / "nope"))
+    assert not k.available
+    assert k.scan() == []
