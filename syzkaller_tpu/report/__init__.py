@@ -63,14 +63,35 @@ OOPSES: List[Oops] = [
          "KASAN: {0} {2} in {1}"),
         (r"BUG: KASAN: ([a-z\-]+) on address(?:.*\n)+?.*(Read|Write) of size ([0-9]+)",
          "KASAN: {0} {1} of size {2}"),
+        # modern KASAN has no Read/Write line for some kinds; kind may be
+        # multi-word ("double-free or invalid-free")
+        (r"BUG: KASAN: ([a-z\- ]+?) in {{FUNC}}", "KASAN: {0} in {1}"),
         (r"BUG: KASAN: (.*)", "KASAN: {0}"),
+        (r"BUG: KCSAN: ([a-z\-]+) in {{FUNC}}", "KCSAN: {0} in {1}"),
+        (r"BUG: KMSAN: ([a-z\-]+) in {{FUNC}}", "KMSAN: {0} in {1}"),
         (r"BUG: unable to handle kernel paging request(?:.*\n)+?.*IP: (?:{{PC}} +)?{{FUNC}}",
          "BUG: unable to handle kernel paging request in {0}"),
         (r"BUG: unable to handle kernel NULL pointer dereference(?:.*\n)+?.*IP: (?:{{PC}} +)?{{FUNC}}",
          "BUG: unable to handle kernel NULL pointer dereference in {0}"),
+        # post-4.19 page-fault report format
+        (r"BUG: unable to handle page fault for address:(?:.*\n)+?"
+         r".*RIP: [0-9]+:{{FUNC}}",
+         "BUG: unable to handle kernel paging request in {0}"),
+        (r"BUG: kernel NULL pointer dereference, address:(?:.*\n)+?"
+         r".*RIP: [0-9]+:{{FUNC}}",
+         "BUG: unable to handle kernel NULL pointer dereference in {0}"),
+        (r"BUG: stack guard page was hit(?:.*\n)+?.*RIP: [0-9]+:{{FUNC}}",
+         "BUG: stack guard page was hit in {0}"),
+        (r"BUG: sleeping function called from invalid context at {{SRC}}",
+         "BUG: sleeping function called from invalid context at {0}"),
+        (r"BUG: workqueue lockup", "BUG: workqueue lockup"),
+        (r"BUG: scheduling while atomic", "BUG: scheduling while atomic"),
+        (r"BUG: corrupted list in {{FUNC}}", "BUG: corrupted list in {0}"),
         (r"BUG: spinlock lockup suspected", "BUG: spinlock lockup suspected"),
         (r"BUG: spinlock recursion", "BUG: spinlock recursion"),
         (r"BUG: spinlock bad magic", "BUG: spinlock bad magic"),
+        (r"BUG: soft lockup.*(?:\n.*)*?RIP: [0-9]+:{{FUNC}}",
+         "BUG: soft lockup in {0}"),
         (r"BUG: soft lockup", "BUG: soft lockup"),
         (r"BUG: .*still has locks held!(?:.*\n)+?.*{{PC}} +{{FUNC}}",
          "BUG: still has locks held in {0}"),
@@ -119,6 +140,9 @@ OOPSES: List[Oops] = [
          r".*is trying to acquire lock(?:.*\n)+?.*at: (?:{{PC}} +)?{{FUNC}}",
          "possible deadlock in {0}"),
         (r"INFO: rcu_(?:preempt|sched|bh) (?:self-)?detected"
+         r"(?: expedited)? stalls?.*(?:\n.*)*?RIP: [0-9]+:{{FUNC}}",
+         "INFO: rcu detected stall in {0}"),
+        (r"INFO: rcu_(?:preempt|sched|bh) (?:self-)?detected"
          r"(?: expedited)? stalls?", "INFO: rcu detected stall"),
         (r"INFO: task .* blocked for more than [0-9]+ seconds",
          "INFO: task hung"),
@@ -136,10 +160,31 @@ OOPSES: List[Oops] = [
         (r"Unable to handle kernel paging request",
          "unable to handle kernel paging request"),
     ]),
+    # ":" (classic) and "," (modern "probably for non-canonical address")
+    # headers; both miss the userspace trap line "traps: ... general
+    # protection fault ip:..." on purpose
     _fmt("general protection fault:", [
         (r"general protection fault:(?:.*\n)+?.*RIP: [0-9]+:(?:{{PC}} +{{PC}} +)?{{FUNC}}",
          "general protection fault in {0}"),
         (r"general protection fault:", "general protection fault"),
+    ]),
+    _fmt("general protection fault,", [
+        (r"general protection fault,.*(?:\n.*)*?RIP: [0-9]+:{{FUNC}}",
+         "general protection fault in {0}"),
+        (r"general protection fault,", "general protection fault"),
+    ]),
+    _fmt("double fault:", [
+        (r"double fault:(?:.*\n)+?.*RIP: [0-9]+:{{FUNC}}",
+         "double fault in {0}"),
+        (r"double fault:", "double fault"),
+    ]),
+    _fmt("stack segment:", [
+        (r"stack segment:(?:.*\n)+?.*RIP: [0-9]+:{{FUNC}}",
+         "stack segment fault in {0}"),
+        (r"stack segment:", "stack segment fault"),
+    ]),
+    _fmt("Kernel stack overflow", [
+        (r"Kernel stack overflow", "kernel stack overflow"),
     ]),
     _fmt("Kernel panic", [
         (r"Kernel panic - not syncing: Attempted to kill init!",
@@ -238,9 +283,15 @@ def parse(output: str, ignores: Sequence[str] = ()) -> Optional[Report]:
     if found is None:
         return None
     start, oops, _line = found
-    # report slice: from the oops line to the end (the reference trims at
-    # subsequent unrelated-context markers; we keep a bounded window)
+    # report slice: from the oops line up to the next UNRELATED oops header
+    # (bounded window otherwise) — multi-line title formats that scan for a
+    # RIP line must never read a later crash's registers
     end = min(len(output), start + (64 << 10))
+    first_line_end = output.find("\n", start)
+    if 0 <= first_line_end < end:
+        nxt = _find(output[first_line_end:end], ign)
+        if nxt is not None:
+            end = first_line_end + nxt[0]
     body = "\n".join(_strip_line(ln)
                      for ln in output[start:end].splitlines())
     title = None
