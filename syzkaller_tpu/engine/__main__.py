@@ -1,0 +1,67 @@
+"""syz-fuzzer binary equivalent: `python -m syzkaller_tpu.engine`.
+
+Role parity with reference /root/reference/syz-fuzzer/fuzzer.go:98-136:
+connect to the manager over RPC, build the call list (optionally probing
+the live machine), run `procs` executor environments, fuzz until killed.
+The manager's vmLoop starts this inside each VM instance.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="syz-fuzzer")
+    ap.add_argument("-manager", default="",
+                    help="manager RPC address host:port")
+    ap.add_argument("-name", default="fuzzer")
+    ap.add_argument("-procs", type=int, default=1)
+    ap.add_argument("-os", default="linux")
+    ap.add_argument("-arch", default="amd64")
+    ap.add_argument("-mock", action="store_true",
+                    help="mock executor (hermetic)")
+    ap.add_argument("-no-detect", action="store_true",
+                    help="skip live supported-syscall detection")
+    ap.add_argument("-device", action="store_true",
+                    help="enable the TPU batched candidate pipeline")
+    ap.add_argument("-sandbox", default="none")
+    ap.add_argument("-iterations", type=int, default=0,
+                    help="stop after N steps (0 = run forever)")
+    ap.add_argument("-leak-check", action="store_true")
+    args = ap.parse_args(argv)
+
+    from ..prog import get_target
+    from .fuzzer import Fuzzer, FuzzerConfig
+
+    target = get_target(args.os, args.arch)
+    manager = None
+    if args.manager:
+        from ..manager.rpc import RemoteManager
+
+        manager = RemoteManager(args.manager, name=args.name)
+    cfg = FuzzerConfig(
+        procs=args.procs,
+        mock=args.mock,
+        use_device=args.device,
+        sandbox=args.sandbox,
+        detect_supported=not args.no_detect and not args.mock,
+        leak_check=args.leak_check,
+    )
+    f = Fuzzer(target, cfg, manager=manager)
+    try:
+        # poll the manager between bursts, like the reference's poll loop
+        while True:
+            f.loop(iterations=args.iterations or 100)
+            f.poll_manager()
+            if args.iterations:
+                return 0
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        f.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

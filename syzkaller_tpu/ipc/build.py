@@ -27,7 +27,12 @@ def build_executor(cxx: str = "g++", force: bool = False) -> Path:
     """Compile executor.cc -> build/syz-executor-<hash8>; returns the path.
 
     Hash-keyed caching: recompiles only when the source changes.
+    SYZ_TPU_EXECUTOR overrides with a prebuilt binary (the vmLoop ships
+    one into guests that have no toolchain).
     """
+    override = os.environ.get("SYZ_TPU_EXECUTOR")
+    if override and os.path.isfile(override) and not force:
+        return Path(override)
     src = _SRC.read_bytes()
     h = hashlib.sha256(src).hexdigest()[:8]
     out = _BUILD_DIR / f"syz-executor-{h}"

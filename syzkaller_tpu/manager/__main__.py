@@ -1,0 +1,51 @@
+"""syz-manager binary equivalent: `python -m syzkaller_tpu.manager`.
+
+Role parity with reference /root/reference/syz-manager/manager.go:115-136
+(main): load the strict-JSON config, start the manager (RPC + HTTP + hub +
+bench series) and the VM fleet loop, run until interrupted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="syz-manager")
+    ap.add_argument("-config", required=True, help="JSON config file")
+    ap.add_argument("-bench", default="", help="append stats JSON here")
+    args = ap.parse_args(argv)
+
+    from ..utils import log
+    from ..utils.config import load_file
+    from . import Manager, ManagerConfig
+    from .vmloop import VMLoop, VMLoopConfig
+
+    cfg = load_file(ManagerConfig, args.config)
+    if args.bench:
+        cfg.bench_file = args.bench
+    mgr = Manager(cfg)
+    loop = VMLoop(mgr, VMLoopConfig(
+        procs=cfg.procs, mock_fuzzer=cfg.mock_executor))
+    loop.start()
+    log.logf(0, "serving rpc on %s, http on %s",
+             mgr.rpc.addr, mgr.http.addr if mgr.http else "-")
+
+    import threading
+
+    stop = threading.Event()  # Event.wait has no check-then-pause race
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    try:
+        stop.wait()
+    finally:
+        loop.stop()
+        loop.join()
+        mgr.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,6 +17,7 @@ Backends here:
 from __future__ import annotations
 
 import os
+import shlex
 import shutil
 import signal
 import socket
@@ -59,6 +60,10 @@ class VMConfig:
     cpu: int = 2
     mem_mb: int = 2048
     qemu_args: List[str] = field(default_factory=list)
+    # isolated-specific (remote physical machines over ssh)
+    targets: List[str] = field(default_factory=list)  # user@host[:port]
+    target_dir: str = "/tmp/syzkaller"
+    target_reboot: bool = False
 
 
 class Instance:
@@ -282,6 +287,43 @@ def _free_port() -> int:
     return port
 
 
+def _ssh_args(target: str, port: int, key: str) -> List[str]:
+    """Shared non-interactive ssh argv (qemu + isolated backends)."""
+    keyargs = ["-i", key] if key else []
+    return ["ssh", "-p", str(port),
+            "-o", "StrictHostKeyChecking=no",
+            "-o", "UserKnownHostsFile=/dev/null",
+            "-o", "ConnectTimeout=10",
+            "-o", "BatchMode=yes", *keyargs, target]
+
+
+def _scp(host_src: str, target: str, dst: str, port: int, key: str) -> None:
+    keyargs = ["-i", key] if key else []
+    subprocess.run(
+        ["scp", "-P", str(port),
+         "-o", "StrictHostKeyChecking=no",
+         "-o", "UserKnownHostsFile=/dev/null",
+         "-o", "ConnectTimeout=10",
+         "-o", "BatchMode=yes", *keyargs,
+         "-r", host_src, f"{target}:{dst}"],
+        check=True, capture_output=True)
+
+
+def _wait_ssh(target: str, port: int, key: str, what: str,
+              timeout: float = 300.0) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            r = subprocess.run(_ssh_args(target, port, key) + ["true"],
+                               capture_output=True, timeout=30)
+            if r.returncode == 0:
+                return
+        except subprocess.TimeoutExpired:
+            pass
+        time.sleep(5)
+    raise TimeoutError(f"{what}: ssh never came up")
+
+
 @register_backend("qemu")
 class QemuPool(Pool):
     def create(self, index: int) -> Instance:
@@ -330,35 +372,16 @@ class QemuInstance(Instance):
             raise
 
     def _ssh_base(self) -> List[str]:
-        key = ["-i", self.cfg.sshkey] if self.cfg.sshkey else []
-        return ["ssh", "-p", str(self.ssh_port),
-                "-o", "StrictHostKeyChecking=no",
-                "-o", "UserKnownHostsFile=/dev/null",
-                "-o", "ConnectTimeout=10",
-                "-o", "BatchMode=yes", *key, "root@127.0.0.1"]
+        return _ssh_args("root@127.0.0.1", self.ssh_port, self.cfg.sshkey)
 
     def _wait_ssh(self, timeout: float = 300.0) -> None:
-        deadline = time.time() + timeout
-        while time.time() < deadline:
-            try:
-                r = subprocess.run(self._ssh_base() + ["true"],
-                                   capture_output=True, timeout=30)
-                if r.returncode == 0:
-                    return
-            except subprocess.TimeoutExpired:
-                pass
-            time.sleep(5)
-        raise TimeoutError(f"qemu VM {self.index}: ssh never came up")
+        _wait_ssh("root@127.0.0.1", self.ssh_port, self.cfg.sshkey,
+                  f"qemu VM {self.index}", timeout)
 
     def copy(self, host_src: str) -> str:
         dst = f"/{os.path.basename(host_src)}"
-        key = ["-i", self.cfg.sshkey] if self.cfg.sshkey else []
-        subprocess.run(
-            ["scp", "-P", str(self.ssh_port),
-             "-o", "StrictHostKeyChecking=no",
-             "-o", "UserKnownHostsFile=/dev/null", *key,
-             host_src, f"root@127.0.0.1:{dst}"],
-            check=True, capture_output=True)
+        _scp(host_src, "root@127.0.0.1", dst, self.ssh_port,
+             self.cfg.sshkey)
         return dst
 
     def forward(self, port: int) -> str:
@@ -388,3 +411,102 @@ class QemuInstance(Instance):
                 pass
             self.proc.wait()
         shutil.rmtree(self.dir, ignore_errors=True)
+
+
+@register_backend("isolated")
+class IsolatedPool(Pool):
+    """Remote physical machines over ssh (reference vm/isolated/
+    isolated.go:22-...): no boot/teardown — each pool index is a
+    long-lived host; close() only kills the running command, and repair
+    optionally reboots."""
+
+    @property
+    def count(self) -> int:
+        return len(self.cfg.targets)
+
+    def create(self, index: int) -> Instance:
+        return IsolatedInstance(self.cfg, index)
+
+
+class IsolatedInstance(Instance):
+    def __init__(self, cfg: VMConfig, index: int):
+        if not cfg.targets:
+            raise ValueError("isolated backend needs targets")
+        self.cfg = cfg
+        self.index = index
+        target = cfg.targets[index % len(cfg.targets)]
+        self.ssh_port = 22
+        if ":" in target.rsplit("@", 1)[-1]:
+            target, port = target.rsplit(":", 1)
+            self.ssh_port = int(port)
+        self.target = target
+        self._procs: List[subprocess.Popen] = []
+        # a just-rebooted host may still be coming up: wait for ssh, then
+        # prepare the working dir
+        _wait_ssh(self.target, self.ssh_port, cfg.sshkey,
+                  f"isolated {target}", timeout=600.0)
+        self._run_ssh(f"mkdir -p {shlex.quote(cfg.target_dir)}",
+                      check=False)
+
+    def _ssh_base(self) -> List[str]:
+        return _ssh_args(self.target, self.ssh_port, self.cfg.sshkey)
+
+    def _run_ssh(self, command: str, check: bool = True):
+        return subprocess.run(self._ssh_base() + [command],
+                              capture_output=True, timeout=60,
+                              check=check)
+
+    def copy(self, host_src: str) -> str:
+        dst = f"{self.cfg.target_dir}/{os.path.basename(host_src)}"
+        _scp(host_src, self.target, dst, self.ssh_port, self.cfg.sshkey)
+        return dst
+
+    def forward(self, port: int) -> str:
+        # the manager is reachable from the remote host directly
+        return f"{_local_ip()}:{port}"
+
+    def run(self, command: str, timeout: float
+            ) -> Tuple[OutputMerger, subprocess.Popen]:
+        merger = OutputMerger()
+        proc = subprocess.Popen(
+            self._ssh_base() + [command],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        self._procs.append(proc)
+        merger.attach(proc.stdout)
+        return merger, proc
+
+    def close(self) -> None:
+        # kill the REMOTE processes first (our fuzzer/executor tree keeps
+        # running after the local ssh dies, like the reference notes), then
+        # the local ssh clients
+        try:
+            self._run_ssh("pkill -KILL -f syzkaller_tpu; "
+                          "pkill -KILL -f syz-executor; true", check=False)
+        except Exception:
+            pass
+        for proc in self._procs:
+            if proc.poll() is None:
+                try:
+                    os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        if self.cfg.target_reboot:
+            try:
+                self._run_ssh("reboot", check=False)
+            except Exception:
+                pass
+
+
+def _local_ip() -> str:
+    """Best-effort address remote targets can reach us on."""
+    import socket as _socket
+
+    s = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+    try:
+        s.connect(("10.255.255.255", 1))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()

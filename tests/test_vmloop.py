@@ -1,0 +1,196 @@
+"""VM-fleet scheduler tests: the full distributed stack, hermetically.
+
+manager (RPC+HTTP) <- vmLoop -> local-backend "VM" -> real syz-fuzzer
+subprocess -> real C++ executor.  The reference has no hermetic test of
+this path (SURVEY.md §4 calls the gap out); the local VM backend closes
+it.
+"""
+
+import os
+import time
+
+import pytest
+
+from syzkaller_tpu.manager import Manager, ManagerConfig
+from syzkaller_tpu.manager.vmloop import VMLoop, VMLoopConfig
+from syzkaller_tpu.prog import get_target
+from syzkaller_tpu.vm import VMConfig
+
+
+@pytest.fixture(scope="module")
+def target():
+    return get_target("linux", "amd64")
+
+
+def _wait(cond, timeout=90.0, period=0.5):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(period)
+    return False
+
+
+def test_end_to_end_local_fleet(tmp_path, target):
+    """Boot a 1-instance local fleet; the real fuzzer subprocess must
+    connect over RPC, execute via the real executor, and feed inputs
+    back into the manager corpus."""
+    mgr = Manager(ManagerConfig(
+        workdir=str(tmp_path), vm=VMConfig(type="local", count=1)),
+        target=target)
+    loop = VMLoop(mgr, VMLoopConfig(procs=1))
+    loop.start()
+    try:
+        assert _wait(lambda: mgr.connected_fuzzers), \
+            "fuzzer never connected over RPC"
+        assert _wait(lambda: len(mgr.corpus) >= 3), \
+            f"corpus never grew (stats: {mgr.snapshot()})"
+        snap = mgr.snapshot()
+        assert snap["corpus"] >= 3
+        assert snap.get("manager_new_inputs", 0) >= 3
+    finally:
+        loop.stop()
+        loop.join()
+        mgr.close()
+
+
+def test_crash_detection_and_repro_scheduling(tmp_path, target,
+                                              monkeypatch):
+    """An instance whose console prints an oops must produce a saved
+    crash and a queued+executed repro job."""
+    mgr = Manager(ManagerConfig(
+        workdir=str(tmp_path), vm=VMConfig(type="local", count=1)),
+        target=target)
+
+    crash_script = (
+        "echo 'executing program 0:'; "
+        "echo 'close(0xffffffffffffffff)'; "
+        "echo ''; "
+        "echo 'BUG: KASAN: use-after-free in fake_func+0x1/0x2'; "
+        "echo 'Read of size 8 at addr ffff8801'; "
+        "sleep 30")
+    monkeypatch.setattr(VMLoop, "_fuzzer_cmd",
+                        lambda self, addr="": crash_script.replace("{name}", "x"))
+
+    # the repro tester would re-run programs in a VM; stub it to always
+    # "reproduce" so the pipeline completes deterministically
+    from syzkaller_tpu import repro as repro_mod
+    from syzkaller_tpu.report import Report
+
+    class StubTester:
+        def __init__(self, *a, **k):
+            pass
+
+        def test_progs(self, progs, opts, duration):
+            if any(p.calls for p in progs):
+                return Report(title="KASAN: use-after-free in fake_func")
+            return None
+
+        def test_c_bin(self, bin_path, duration):
+            return None
+
+    monkeypatch.setattr(repro_mod, "VMTester", StubTester)
+
+    loop = VMLoop(mgr, VMLoopConfig())
+    loop.start()
+    try:
+        assert _wait(lambda: loop.crashes >= 1), "crash never detected"
+        assert _wait(lambda: loop.repros_done >= 1), \
+            f"repro never completed (stats {mgr.snapshot()})"
+        title = "KASAN: use-after-free Read in fake_func"
+        assert title in mgr.crashes
+        from syzkaller_tpu.utils.hash import hash_str
+
+        d = os.path.join(mgr.crashdir, hash_str(title.encode())[:16])
+        assert os.path.exists(os.path.join(d, "repro.prog"))
+        assert not mgr.need_repro(title)  # satisfied by the saved repro
+    finally:
+        loop.stop()
+        loop.join()
+        mgr.close()
+
+
+def test_no_output_pseudo_crash(tmp_path, target, monkeypatch):
+    """Silent instances produce the 'no output' pseudo-crash."""
+    mgr = Manager(ManagerConfig(
+        workdir=str(tmp_path), vm=VMConfig(type="local", count=1)),
+        target=target)
+    monkeypatch.setattr(VMLoop, "_fuzzer_cmd",
+                        lambda self, addr="": "sleep 300")
+    loop = VMLoop(mgr, VMLoopConfig())
+    # tighten the silence threshold for the test
+    orig = loop._run_instance
+
+    def fast_run(idx):
+        inst = loop.pool.create(idx)
+        try:
+            from syzkaller_tpu.vm import monitor_execution
+
+            merger, proc = inst.run("sleep 300", timeout=60.0)
+            res = monitor_execution(merger, proc, timeout=60.0,
+                                    no_output_timeout=2.0,
+                                    stop=loop.stop_ev)
+            if res.no_output:
+                from syzkaller_tpu.report import Report
+
+                mgr.save_crash(Report(title="no output from test machine"),
+                               res.output, idx)
+                loop.crashes += 1
+        finally:
+            inst.close()
+
+    monkeypatch.setattr(loop, "_run_instance", fast_run)
+    loop.start()
+    try:
+        assert _wait(lambda: "no output from test machine" in mgr.crashes,
+                     timeout=30.0)
+    finally:
+        loop.stop()
+        loop.join()
+        mgr.close()
+
+
+def test_isolated_backend_target_parsing(monkeypatch):
+    """isolated pool: target list parsing + per-index assignment (no
+    actual ssh: the setup command is stubbed)."""
+    import syzkaller_tpu.vm as vm_mod
+    from syzkaller_tpu.vm import IsolatedInstance, VMConfig, create
+
+    monkeypatch.setattr(IsolatedInstance, "_run_ssh",
+                        lambda self, cmd, check=True: None)
+    # no ssh binary in the test environment: skip the readiness probe
+    monkeypatch.setattr(vm_mod, "_wait_ssh",
+                        lambda target, port, key, what, timeout=0: None)
+    pool = create(VMConfig(type="isolated",
+                           targets=["root@h1", "fuzz@h2:2222"]))
+    assert pool.count == 2
+    i0 = pool.create(0)
+    assert (i0.target, i0.ssh_port) == ("root@h1", 22)
+    i1 = pool.create(1)
+    assert (i1.target, i1.ssh_port) == ("fuzz@h2", 2222)
+    # ssh argv shape
+    base = i1._ssh_base()
+    assert base[0] == "ssh" and "-p" in base and "2222" in base
+    assert base[-1] == "fuzz@h2"
+    i0.close()
+    i1.close()
+
+
+def test_manager_cli_config(tmp_path):
+    """syz-manager CLI: strict config load rejects unknown fields."""
+    import json
+    import pytest as _pytest
+
+    from syzkaller_tpu.manager import ManagerConfig
+    from syzkaller_tpu.utils.config import load_file
+
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps({
+        "name": "m0", "workdir": str(tmp_path / "wd"),
+        "vm": {"type": "local", "count": 2}}))
+    cfg = load_file(ManagerConfig, str(good))
+    assert cfg.vm.count == 2 and cfg.name == "m0"
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"name": "m0", "no_such_field": 1}))
+    with _pytest.raises(Exception):
+        load_file(ManagerConfig, str(bad))
