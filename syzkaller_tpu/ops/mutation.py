@@ -351,9 +351,10 @@ def mutate_program(key, dt: DeviceTables, row: Row, donor: Row,
 
 def mutate_rows(key, dt: DeviceTables, call_id, slot_val, data,
                 rounds: int = 2):
-    """Unjitted vmapped batch mutation; donors are the batch rolled by
-    one.  Shared by the single-chip `mutate_batch` and the sharded
-    per-device body in parallel/mesh.py."""
+    """Unjitted vmapped batch mutation with iid per-lane op choice —
+    the semantic reference implementation, kept for tests and for
+    comparing against mutate_rows_stratified (the production hot path in
+    mutate_batch / parallel/mesh.py / bench.py)."""
     B = call_id.shape[0]
     keys = jax.random.split(key, B)
     donor = (jnp.roll(call_id, 1, axis=0),
@@ -367,10 +368,91 @@ def mutate_rows(key, dt: DeviceTables, call_id, slot_val, data,
     return jax.vmap(per)(keys, call_id, slot_val, data, *donor)
 
 
+# Stratified variant: under vmap, lax.switch lowers to select and EVERY
+# lane pays for ALL five op bodies.  Here each op gets a static slice of
+# the batch (sizes ~ the reference's op mix) and a fresh random lane
+# permutation each round mixes programs across ops — stratified rather
+# than iid op assignment, with each op body running on only its share of
+# the batch.
+_OP_MIX = ((0, 1), (1, 44), (2, 35), (3, 10), (4, 10))  # (op, weight%)
+
+
+def _op_slices(B: int):
+    """Largest-remainder allocation; every op keeps >=1 lane when the
+    batch allows (small shards must not silently lose splicing)."""
+    total = sum(w for _, w in _OP_MIX)
+    raw = [(B * w) / total for _, w in _OP_MIX]
+    sizes = [int(r) for r in raw]
+    if B >= len(_OP_MIX):
+        for i in range(len(sizes)):
+            if sizes[i] == 0:
+                sizes[i] = 1
+    # settle the remainder on the ops with the largest fractional parts
+    while sum(sizes) > B:
+        sizes[max(range(len(sizes)), key=lambda i: sizes[i])] -= 1
+    rema = sorted(range(len(sizes)), key=lambda i: raw[i] - int(raw[i]),
+                  reverse=True)
+    j = 0
+    while sum(sizes) < B:
+        sizes[rema[j % len(rema)]] += 1
+        j += 1
+    out = []
+    off = 0
+    for n in sizes:
+        out.append((off, n))
+        off += n
+    return out
+
+
+def mutate_rows_stratified(key, dt: DeviceTables, call_id, slot_val,
+                           data, rounds: int = 2):
+    B = call_id.shape[0]
+
+    ops = [
+        lambda k, row, dn: splice(k, dt, row, dn),
+        lambda k, row, dn: insert_call(k, dt, row),
+        lambda k, row, dn: value_mutate(k, dt, row),
+        lambda k, row, dn: data_mutate(k, dt, row),
+        lambda k, row, dn: remove_call(k, dt, row),
+    ]
+    slices = _op_slices(B)
+
+    def one_round(carry, rkey):
+        cid, sval, dat = carry
+        kperm, kops = jax.random.split(rkey)
+        perm = jax.random.permutation(kperm, B)
+        cid, sval, dat = cid[perm], sval[perm], dat[perm]
+        donor = (jnp.roll(cid, 1, axis=0), jnp.roll(sval, 1, axis=0),
+                 jnp.roll(dat, 1, axis=0))
+        outs = []
+        for (op_i, _w), (off, n), kop in zip(
+                _OP_MIX, slices, jax.random.split(kops, len(ops))):
+            if n == 0:
+                continue
+            sl = slice(off, off + n)
+            keys = jax.random.split(kop, n)
+            out = jax.vmap(ops[op_i])(
+                keys, (cid[sl], sval[sl], dat[sl]),
+                (donor[0][sl], donor[1][sl], donor[2][sl]))
+            outs.append(out)
+        cid = jnp.concatenate([o[0] for o in outs])
+        sval = jnp.concatenate([o[1] for o in outs])
+        dat = jnp.concatenate([o[2] for o in outs])
+        return (cid, sval, dat), None
+
+    (cid, sval, dat), _ = jax.lax.scan(
+        one_round, (call_id, slot_val, data),
+        jax.random.split(key, rounds))
+    return cid, sval, dat
+
+
 @partial(jax.jit, static_argnames=("rounds",))
 def mutate_batch(key, dt: DeviceTables, call_id, slot_val, data,
                  rounds: int = 2):
-    return mutate_rows(key, dt, call_id, slot_val, data, rounds)
+    # stratified assignment beats per-lane switch under vmap (every lane
+    # would otherwise execute all five op bodies)
+    return mutate_rows_stratified(key, dt, call_id, slot_val, data,
+                                  rounds)
 
 
 def _sample_values(key, dt: DeviceTables, ids):

@@ -138,7 +138,7 @@ def _step_body(dt: DeviceTables, rounds: int, key, cid, sval, data,
     i = jax.lax.axis_index(AXIS_FUZZ)
     j = jax.lax.axis_index(AXIS_COVER)
     key = jax.random.fold_in(jax.random.fold_in(key, i), j)
-    cid, sval, data = dmut.mutate_rows(key, dt, cid, sval, data, rounds)
+    cid, sval, data = dmut.mutate_rows_stratified(key, dt, cid, sval, data, rounds)
     sigs = jax.vmap(call_fingerprints)(cid, sval)      # [b, C] u32
     sig_shard, fresh = fold_signals(sig_shard, sigs)
     return cid, sval, data, sig_shard, fresh

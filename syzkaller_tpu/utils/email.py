@@ -1,0 +1,126 @@
+"""Email parsing/reply for the dashboard reporting workflow.
+
+Role parity with reference /root/reference/pkg/email (parser.go:20-226,
+reply.go:12-50): parse incoming bug-report replies (sender, subject,
+message-id, body, `#syz` commands, address contexts for bug-id routing),
+merge CC lists, and form quoted replies.  Built on the stdlib email
+package rather than hand-rolling MIME.
+"""
+
+from __future__ import annotations
+
+import email
+import email.utils
+import re
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+# commands understood in reply bodies (reference extractCommand):
+#   #syz fix: commit title
+#   #syz dup: other bug title
+#   #syz invalid / #syz undup / #syz upstream / #syz test: repo branch
+COMMAND_RE = re.compile(r"^#syz(?:bot)?[ \t]+([a-z\-]+):?[ \t]*(.*)$",
+                        re.M)
+
+
+@dataclass
+class Email:
+    bug_id: str = ""
+    message_id: str = ""
+    from_addr: str = ""
+    cc: List[str] = field(default_factory=list)
+    subject: str = ""
+    body: str = ""
+    command: str = ""
+    command_args: str = ""
+
+
+def add_addr_context(addr: str, context: str) -> str:
+    """user@host -> user+context@host (reference AddAddrContext): the
+    context routes a reply back to the bug it concerns."""
+    if "@" not in addr:
+        raise ValueError(f"bad email address {addr!r}")
+    user, host = addr.rsplit("@", 1)
+    return f"{user}+{context}@{host}"
+
+
+def remove_addr_context(addr: str) -> Tuple[str, str]:
+    """Inverse of add_addr_context; returns (bare_addr, context)."""
+    if "@" not in addr:
+        raise ValueError(f"bad email address {addr!r}")
+    user, host = addr.rsplit("@", 1)
+    if "+" not in user:
+        return addr, ""
+    bare, context = user.split("+", 1)
+    return f"{bare}@{host}", context
+
+
+def parse(raw: str, own_emails: Tuple[str, ...] = ()) -> Email:
+    """Parse a raw RFC-2822 message (reference Parse, parser.go:37-118)."""
+    msg = email.message_from_string(raw)
+    out = Email()
+    out.message_id = (msg.get("Message-ID") or "").strip()
+    out.subject = " ".join((msg.get("Subject") or "").split())
+    from_addrs = email.utils.getaddresses([msg.get("From") or ""])
+    if from_addrs:
+        out.from_addr = from_addrs[0][1]
+
+    own_bare = set()
+    for own in own_emails:
+        bare, _ = remove_addr_context(own) if "@" in own else (own, "")
+        own_bare.add(bare.lower())
+
+    cc: List[str] = []
+    for hdr in ("To", "Cc", "From"):
+        for _name, addr in email.utils.getaddresses([msg.get(hdr) or ""]):
+            if not addr:
+                continue
+            bare, context = remove_addr_context(addr)
+            if bare.lower() in own_bare:
+                # one of OUR addresses: its +context names the bug
+                if context and not out.bug_id:
+                    out.bug_id = context
+                continue
+            if bare.lower() not in (c.lower() for c in cc):
+                cc.append(bare)
+    out.cc = sorted(cc)
+
+    out.body = _extract_body(msg)
+    m = COMMAND_RE.search(out.body)
+    if m:
+        out.command = m.group(1)
+        out.command_args = m.group(2).strip()
+    return out
+
+
+def _extract_body(msg) -> str:
+    if msg.is_multipart():
+        for part in msg.walk():
+            if part.get_content_type() == "text/plain":
+                payload = part.get_payload(decode=True)
+                if payload is not None:
+                    return payload.decode(
+                        part.get_content_charset() or "utf-8", "replace")
+        return ""
+    payload = msg.get_payload(decode=True)
+    if payload is None:
+        return str(msg.get_payload())
+    return payload.decode(msg.get_content_charset() or "utf-8", "replace")
+
+
+def merge_email_lists(*lists: List[str]) -> List[str]:
+    """Dedup + canonicalize + sort (reference MergeEmailLists)."""
+    seen = {}
+    for lst in lists:
+        for addr in lst:
+            _name, bare = email.utils.parseaddr(addr)
+            if bare and bare.lower() not in seen:
+                seen[bare.lower()] = bare
+    return sorted(seen.values())
+
+
+def form_reply(original_body: str, reply: str) -> str:
+    """Quote the original and prepend the reply after the first quoted
+    line (reference FormReply: reply goes above the quote)."""
+    quoted = "\n".join("> " + ln for ln in original_body.splitlines())
+    return f"{reply.rstrip()}\n\n{quoted}\n"

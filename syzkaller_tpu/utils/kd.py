@@ -1,0 +1,81 @@
+"""Minimal Windows KD (kernel debugger) protocol decoder.
+
+Role parity with reference /root/reference/pkg/kd/kd.go:32-100: scan a
+serial byte stream for KD data packets ('0000' leader), and rewrite
+STATE_CHANGE64 exception notifications into BUG: lines the crash-report
+parser can pick up — how Windows targets surface crashes without a
+console oops.  Original implementation against the public protocol
+layout (windbgkd.h): 16-byte packet header (leader u32, type u16,
+byte-count u16, id u32, checksum u32) followed by the payload.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+DATA_LEADER = b"0000"          # 0x30303030
+TYPE_STATE_CHANGE64 = 7
+
+_HDR = struct.Struct("<4sHHII")
+# stateChange64 prefix: state u32, proc_level u16, proc u16, nproc u32,
+# thread u64, pc u64, then exception64: code u32, flags u32, record u64,
+# address u64, num_params u32, unused u32, params[15] u64, first_chance u32
+_STATE_PREFIX = struct.Struct("<IHHIQQ")
+_EXCEPTION = struct.Struct("<IIQQII15QI")
+_STATE_CHANGE_MIN = _STATE_PREFIX.size + _EXCEPTION.size
+
+
+@dataclass
+class Exception64:
+    code: int
+    flags: int
+    address: int
+    first_chance: bool
+    pc: int
+    processor: int
+
+
+def decode(data: bytes) -> Tuple[int, int, bytes]:
+    """(start, size, decoded): scan for one packet at/after `start`.
+
+    size==0 means incomplete — retry with more data from `start`.
+    `decoded` is a synthesized crash line for exception packets, else
+    empty (reference Decode kd.go:32-65 semantics)."""
+    if len(data) < len(DATA_LEADER):
+        return 0, 0, b""
+    start = data.find(DATA_LEADER)
+    if start == -1:
+        # keep a tail that could begin a leader next read
+        return max(0, len(data) - len(DATA_LEADER) - 1), 0, b""
+    if len(data) - start < _HDR.size:
+        return start, 0, b""
+    _leader, typ, count, _pid, _csum = _HDR.unpack_from(data, start)
+    if len(data) - start < _HDR.size + count:
+        return start, 0, b""
+    size = _HDR.size + count
+    if typ != TYPE_STATE_CHANGE64 or count < _STATE_CHANGE_MIN:
+        return start, size, b""
+    exc = parse_state_change(data[start + _HDR.size:start + size])
+    if exc is None:
+        return start, size, b""
+    chance = "first" if exc.first_chance else "second"
+    line = (f"\n\nBUG: {chance} chance exception 0x{exc.code:x} "
+            f"at pc 0x{exc.pc:x} addr 0x{exc.address:x} "
+            f"(cpu {exc.processor})\n\n")
+    return start, size, line.encode()
+
+
+def parse_state_change(payload: bytes) -> Optional[Exception64]:
+    if len(payload) < _STATE_CHANGE_MIN:
+        return None
+    _state, _lvl, proc, _n, _thread, pc = _STATE_PREFIX.unpack_from(
+        payload, 0)
+    fields = _EXCEPTION.unpack_from(payload, _STATE_PREFIX.size)
+    code, flags, _record, address = fields[0], fields[1], fields[2], \
+        fields[3]
+    first_chance = fields[-1]
+    return Exception64(code=code, flags=flags, address=address,
+                       first_chance=bool(first_chance), pc=pc,
+                       processor=proc)

@@ -42,6 +42,17 @@ def register_backend(name: str):
 
 def create(cfg: "VMConfig") -> "Pool":
     if cfg.type not in _BACKENDS:
+        # backends in submodules (adb, gce) register on import
+        import importlib
+
+        try:
+            importlib.import_module(f".{cfg.type}", __package__)
+        except ModuleNotFoundError as e:
+            # only "no such backend module" is expected; a backend whose
+            # own dependency is missing must surface the real error
+            if e.name != f"{__package__}.{cfg.type}":
+                raise
+    if cfg.type not in _BACKENDS:
         raise ValueError(f"unknown VM type {cfg.type!r} "
                          f"(known: {sorted(_BACKENDS)})")
     return _BACKENDS[cfg.type](cfg)

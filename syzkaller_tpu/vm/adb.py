@@ -1,0 +1,108 @@
+"""adb VM backend: physical Android devices over USB.
+
+Role parity with reference /root/reference/vm/adb/adb.go:27-...: each
+pool index is a device serial; copy = `adb push`, run = `adb shell`,
+manager reachability via `adb reverse`; close kills the shell and
+best-effort reboots on request.  Console output is the device's dmesg
+stream merged with the command output (the reference reads a USB-serial
+console; dmesg -w is the toolless equivalent).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+from typing import List, Tuple
+
+from . import Instance, OutputMerger, Pool, VMConfig, register_backend
+
+
+@register_backend("adb")
+class AdbPool(Pool):
+    @property
+    def count(self) -> int:
+        return len(self.cfg.targets)
+
+    def create(self, index: int) -> "AdbInstance":
+        return AdbInstance(self.cfg, index)
+
+
+class AdbInstance(Instance):
+    def __init__(self, cfg: VMConfig, index: int):
+        if not cfg.targets:
+            raise ValueError("adb backend needs device serials in targets")
+        self.cfg = cfg
+        self.index = index
+        self.serial = cfg.targets[index % len(cfg.targets)]
+        self._procs: List[subprocess.Popen] = []
+        self._dmesg = None
+        self._reversed: List[int] = []
+        self._adb(["wait-for-device"], timeout=120)
+        self._adb(["shell", f"mkdir -p {cfg.target_dir}"])
+
+    def _adb(self, args: List[str], timeout: float = 60.0,
+             check: bool = True):
+        return subprocess.run(["adb", "-s", self.serial, *args],
+                              capture_output=True, timeout=timeout,
+                              check=check)
+
+    def copy(self, host_src: str) -> str:
+        dst = f"{self.cfg.target_dir}/{os.path.basename(host_src)}"
+        self._adb(["push", host_src, dst], timeout=300)
+        self._adb(["shell", f"chmod 755 {dst}"])
+        return dst
+
+    def forward(self, port: int) -> str:
+        # reverse: device connections to localhost:port reach the host
+        self._adb(["reverse", f"tcp:{port}", f"tcp:{port}"])
+        self._reversed.append(port)
+        return f"127.0.0.1:{port}"
+
+    def run(self, command: str, timeout: float
+            ) -> Tuple[OutputMerger, subprocess.Popen]:
+        merger = OutputMerger()
+        # console: kernel log stream alongside the command's own output;
+        # one watcher per instance — kill the previous run's stream
+        if self._dmesg is not None and self._dmesg.poll() is None:
+            try:
+                os.killpg(os.getpgid(self._dmesg.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        self._dmesg = subprocess.Popen(
+            ["adb", "-s", self.serial, "shell", "dmesg -w"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            start_new_session=True)
+        self._procs.append(self._dmesg)
+        merger.attach(self._dmesg.stdout, finish=False)
+        proc = subprocess.Popen(
+            ["adb", "-s", self.serial, "shell", command],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        self._procs.append(proc)
+        merger.attach(proc.stdout, finish=False)
+        return merger, proc
+
+    def close(self) -> None:
+        try:
+            self._adb(["shell", "pkill -f syzkaller_tpu; "
+                       "pkill -f syz-executor; true"], check=False)
+        except Exception:
+            pass
+        for port in self._reversed:
+            try:
+                self._adb(["reverse", "--remove", f"tcp:{port}"],
+                          check=False)
+            except Exception:
+                pass
+        for proc in self._procs:
+            if proc.poll() is None:
+                try:
+                    os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        if self.cfg.target_reboot:
+            try:
+                self._adb(["reboot"], check=False)
+            except Exception:
+                pass

@@ -1,0 +1,129 @@
+"""GCE VM backend, via the gcloud CLI.
+
+Role parity with reference /root/reference/vm/gce/gce.go:36-... (+
+pkg/gce API wrapper): boot instances from an image, ssh in via the
+external IP, delete on close.  The reference speaks the GCE REST API
+directly; this drives the gcloud CLI instead — same capability, no
+vendored cloud SDK — and is gated on gcloud being installed+authed.
+
+Config mapping: cfg.image = GCE image name, cfg.targets[0] optionally
+"project/zone/machine-type".
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import subprocess
+from typing import List, Tuple
+
+from . import (
+    Instance,
+    OutputMerger,
+    Pool,
+    VMConfig,
+    _scp,
+    _ssh_args,
+    _wait_ssh,
+    register_backend,
+)
+
+
+class GceError(RuntimeError):
+    pass
+
+
+def _gcloud(args: List[str], timeout: float = 300.0) -> str:
+    if shutil.which("gcloud") is None:
+        raise GceError("gcloud CLI not installed/authenticated — the gce "
+                       "backend needs it (see cloud.google.com/sdk)")
+    r = subprocess.run(["gcloud", *args, "--format=json"],
+                       capture_output=True, text=True, timeout=timeout)
+    if r.returncode != 0:
+        raise GceError(f"gcloud {' '.join(args)} failed: {r.stderr[-2000:]}")
+    return r.stdout
+
+
+@register_backend("gce")
+class GcePool(Pool):
+    def create(self, index: int) -> "GceInstance":
+        return GceInstance(self.cfg, index)
+
+
+class GceInstance(Instance):
+    def __init__(self, cfg: VMConfig, index: int):
+        self.cfg = cfg
+        self.index = index
+        spec = (cfg.targets[0] if cfg.targets else "//").split("/")
+        self.project = spec[0] or None
+        self.zone = spec[1] if len(spec) > 1 and spec[1] else \
+            "us-central1-a"
+        machine = spec[2] if len(spec) > 2 and spec[2] else "e2-standard-2"
+        # unique across runs/pools: a leaked instance from a crashed
+        # manager must not block the next create
+        import secrets
+
+        self.name = f"syzkaller-tpu-{index}-{secrets.token_hex(4)}"
+        self._procs: List[subprocess.Popen] = []
+        args = ["compute", "instances", "create", self.name,
+                "--zone", self.zone, "--machine-type", machine,
+                "--image", cfg.image]
+        if self.project:
+            args += ["--project", self.project]
+        out = json.loads(_gcloud(args, timeout=600.0))
+        try:
+            try:
+                self.ip = out[0]["networkInterfaces"][0][
+                    "accessConfigs"][0]["natIP"]
+            except (KeyError, IndexError) as e:
+                raise GceError(
+                    f"no external IP in create response: {out}") from e
+            self.target = f"root@{self.ip}"
+            _wait_ssh(self.target, 22, cfg.sshkey, f"gce {self.name}",
+                      timeout=600.0)
+        except BaseException:
+            # never leak a billed instance the caller has no handle to
+            self.close()
+            raise
+
+    def copy(self, host_src: str) -> str:
+        import os
+
+        dst = f"/{os.path.basename(host_src)}"
+        _scp(host_src, self.target, dst, 22, self.cfg.sshkey)
+        return dst
+
+    def forward(self, port: int) -> str:
+        from . import _local_ip
+
+        return f"{_local_ip()}:{port}"
+
+    def run(self, command: str, timeout: float
+            ) -> Tuple[OutputMerger, subprocess.Popen]:
+        merger = OutputMerger()
+        proc = subprocess.Popen(
+            _ssh_args(self.target, 22, self.cfg.sshkey) + [command],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        self._procs.append(proc)
+        merger.attach(proc.stdout)
+        return merger, proc
+
+    def close(self) -> None:
+        import os
+        import signal as _signal
+
+        for proc in self._procs:
+            if proc.poll() is None:
+                try:
+                    os.killpg(os.getpgid(proc.pid), _signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        try:
+            args = ["compute", "instances", "delete", self.name,
+                    "--zone", self.zone, "--quiet"]
+            if self.project:
+                args += ["--project", self.project]
+            _gcloud(args, timeout=600.0)
+        except GceError:
+            pass  # best effort; the CI reaps leaked instances by prefix

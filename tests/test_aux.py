@@ -1,0 +1,185 @@
+"""Fleet-aux package tests: email workflow, KD decoder, kernel build
+helper, adb/gce backend registration."""
+
+import os
+import struct
+import subprocess
+import sys
+
+import pytest
+
+
+# --------------------------------------------------------------------- #
+# email (reference pkg/email)
+
+
+def test_email_parse_command_and_bugid():
+    from syzkaller_tpu.utils.email import parse
+
+    raw = """From: Dev Eloper <dev@kernel.org>
+To: syzbot+abcd1234@syzkaller.example.com, lkml@vger.kernel.org
+Cc: maintainer@kernel.org
+Subject: Re: [syzbot] KASAN: use-after-free in foo
+Message-ID: <msg123@mail>
+
+Thanks for the report.
+
+#syz fix: net: fix refcount leak in foo
+"""
+    em = parse(raw, own_emails=("syzbot@syzkaller.example.com",))
+    assert em.bug_id == "abcd1234"
+    assert em.from_addr == "dev@kernel.org"
+    assert em.command == "fix"
+    assert em.command_args == "net: fix refcount leak in foo"
+    assert "lkml@vger.kernel.org" in em.cc
+    assert "maintainer@kernel.org" in em.cc
+    assert "syzbot" not in " ".join(em.cc)
+    assert em.message_id == "<msg123@mail>"
+
+
+def test_email_addr_context_roundtrip():
+    from syzkaller_tpu.utils.email import (
+        add_addr_context,
+        remove_addr_context,
+    )
+
+    a = add_addr_context("bot@example.com", "bug42")
+    assert a == "bot+bug42@example.com"
+    assert remove_addr_context(a) == ("bot@example.com", "bug42")
+    assert remove_addr_context("x@y.z") == ("x@y.z", "")
+
+
+def test_email_merge_and_reply():
+    from syzkaller_tpu.utils.email import form_reply, merge_email_lists
+
+    merged = merge_email_lists(
+        ["A <a@x.com>", "b@y.com"], ["a@x.com", "c@z.com"])
+    assert merged == ["a@x.com", "b@y.com", "c@z.com"]
+    rep = form_reply("original line 1\nline 2", "my answer")
+    assert rep.startswith("my answer\n\n> original line 1\n> line 2")
+
+
+def test_email_multipart_body():
+    from syzkaller_tpu.utils.email import parse
+
+    raw = (
+        "From: a@b.c\n"
+        "Subject: t\n"
+        'Content-Type: multipart/alternative; boundary="BBB"\n'
+        "\n--BBB\n"
+        "Content-Type: text/html\n\n<b>nope</b>\n"
+        "--BBB\n"
+        "Content-Type: text/plain\n\n#syz invalid\n"
+        "--BBB--\n")
+    em = parse(raw)
+    assert em.command == "invalid"
+
+
+# --------------------------------------------------------------------- #
+# KD decoder (reference pkg/kd)
+
+
+def _kd_packet(typ, payload):
+    hdr = struct.pack("<4sHHII", b"0000", typ, len(payload), 1, 0)
+    return hdr + payload
+
+
+def test_kd_state_change_decodes():
+    from syzkaller_tpu.utils import kd
+
+    prefix = struct.pack("<IHHIQQ", 3, 0, 1, 2, 0xCAFE, 0xFFFF800000001234)
+    exc = struct.pack("<IIQQII15QI", 0xC0000005, 0, 0, 0xDEAD, 1, 0,
+                      *([0] * 15), 1)
+    stream = b"garbage" + _kd_packet(kd.TYPE_STATE_CHANGE64, prefix + exc)
+    start, size, decoded = kd.decode(stream)
+    assert start == len(b"garbage")
+    assert size == len(stream) - start
+    text = decoded.decode()
+    assert "BUG: first chance exception 0xc0000005" in text
+    assert "pc 0xffff800000001234" in text and "addr 0xdead" in text
+
+
+def test_kd_non_exception_packet_skipped():
+    from syzkaller_tpu.utils import kd
+
+    stream = _kd_packet(2, b"\x00" * 8)
+    start, size, decoded = kd.decode(stream)
+    assert (start, size, decoded) == (0, len(stream), b"")
+
+
+def test_kd_incomplete_waits():
+    from syzkaller_tpu.utils import kd
+
+    full = _kd_packet(kd.TYPE_STATE_CHANGE64, b"\x00" * 300)
+    start, size, _ = kd.decode(full[:10])
+    assert size == 0  # incomplete: caller should retry with more data
+
+
+# --------------------------------------------------------------------- #
+# kernel build helper (reference pkg/kernel)
+
+
+def test_kernel_build_drives_make(tmp_path):
+    """Build against a fake kernel tree whose `make` records invocations."""
+    from syzkaller_tpu.ci import kernel
+
+    kdir = tmp_path / "linux"
+    (kdir / "arch/x86/boot").mkdir(parents=True)
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    make = bindir / "make"
+    make.write_text(f"""#!/bin/sh
+echo "$@" >> {kdir}/make.log
+if [ "$1" = bzImage ]; then touch {kdir}/arch/x86/boot/bzImage; fi
+""")
+    make.chmod(0o755)
+    cfgfile = tmp_path / "kcfg"
+    cfgfile.write_text("CONFIG_KASAN=y\n")
+    old_path = os.environ["PATH"]
+    os.environ["PATH"] = f"{bindir}:{old_path}"
+    try:
+        bz = kernel.build(str(kdir), str(cfgfile), compiler="gcc-13")
+    finally:
+        os.environ["PATH"] = old_path
+    assert os.path.exists(bz)
+    log = (kdir / "make.log").read_text()
+    assert "olddefconfig" in log
+    assert "CC=gcc-13" in log
+    assert (kdir / ".config").read_text() == "CONFIG_KASAN=y\n"
+
+
+def test_kernel_build_failure_raises(tmp_path):
+    from syzkaller_tpu.ci import kernel
+
+    kdir = tmp_path / "linux"
+    kdir.mkdir()
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    (bindir / "make").write_text("#!/bin/sh\necho boom >&2; exit 2\n")
+    (bindir / "make").chmod(0o755)
+    cfgfile = tmp_path / "kcfg"
+    cfgfile.write_text("")
+    old_path = os.environ["PATH"]
+    os.environ["PATH"] = f"{bindir}:{old_path}"
+    try:
+        with pytest.raises(kernel.KernelBuildError, match="boom"):
+            kernel.build(str(kdir), str(cfgfile))
+    finally:
+        os.environ["PATH"] = old_path
+
+
+# --------------------------------------------------------------------- #
+# backend registration
+
+
+def test_lazy_backend_registration():
+    from syzkaller_tpu.vm import VMConfig, create
+
+    # adb registers lazily on first use; gce too (both will fail to CREATE
+    # instances without hardware/cloud, but the pool must resolve)
+    pool = create(VMConfig(type="adb", targets=["SERIAL1", "SERIAL2"]))
+    assert pool.count == 2
+    pool2 = create(VMConfig(type="gce", image="img", count=3))
+    assert pool2.count == 3
+    with pytest.raises(ValueError, match="unknown VM type"):
+        create(VMConfig(type="nonexistent"))

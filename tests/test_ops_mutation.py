@@ -170,3 +170,19 @@ def test_mutate_batch_live_prefix_invariant(env):
     for rowv in carr:
         nlive = int((rowv >= 0).sum())
         assert (rowv[:nlive] >= 0).all() and (rowv[nlive:] == -1).all(), rowv
+
+
+def test_stratified_mutation_decodes(env):
+    """mutate_rows_stratified (the bench/mesh hot path) keeps tensor
+    invariants: decodable programs, in-range call ids, real change."""
+    target, tables, fmt, dt = env
+    cid, sval, data = M.generate_batch(
+        jax.random.PRNGKey(5), dt, B=B, C=fmt.max_calls)
+    ncid, nsval, ndata = jax.jit(
+        lambda k, a, b, c: M.mutate_rows_stratified(k, dt, a, b, c, 2)
+    )(jax.random.PRNGKey(7), cid, sval, data)
+    _decode_all(env, ncid, nsval, ndata)
+    ncid_np = np.asarray(ncid)
+    assert ((ncid_np >= -1) & (ncid_np < dt.n_calls)).all()
+    assert not (np.array_equal(ncid_np, np.asarray(cid))
+                and np.array_equal(np.asarray(nsval), np.asarray(sval)))
