@@ -274,6 +274,12 @@ class Fuzzer:
                      ) -> Optional[List[int]]:
         for info in infos:
             if info.index == call_index:
+                # ipc pads calls the child never reached (executed=False,
+                # errno=-1); treat those as "no result, retry" — not as
+                # empty signal, which would make triage discard the input
+                # on one flaky run (reference counts them as notexecuted)
+                if not info.executed:
+                    return None
                 return info.signal
         return None
 

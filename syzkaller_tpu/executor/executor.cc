@@ -1098,12 +1098,17 @@ static void handle_exec(const req_t* req) {
     usleep(500);
   }
   uint64 ns = now_ns() - t0;
+  // A child killed by a signal or exiting nonzero is a NORMAL program end
+  // (programs legitimately kill themselves: seccomp strict mode, exit(n),
+  // stray SEGV outside NONFAILING) — unexecuted calls simply have no
+  // records.  Only the executor's own failure convention (fail() exits
+  // 67, matching the reference's magic status) reports kStatusFailed.
   if (hanged)
     reply(kStatusHanged, ns);
-  else if (done && WIFEXITED(status) && WEXITSTATUS(status) == 0)
-    reply(kStatusOk, ns);
-  else
+  else if (done && WIFEXITED(status) && WEXITSTATUS(status) == 67)
     reply(kStatusFailed, ns);
+  else
+    reply(kStatusOk, ns);
 }
 
 static void handle_handshake(const req_t* req) {

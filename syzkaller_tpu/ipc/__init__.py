@@ -248,6 +248,18 @@ class Env:
         elif status == P.STATUS_HANGED:
             hanged = True
         infos = self._parse_out()
+        # Pad calls with no record (child died mid-program: seccomp strict,
+        # exit(), hang kill) as not-executed, errno=-1 — one info per call,
+        # like the reference's ipc (reference pkg/ipc/ipc_linux.go fills
+        # len(p.Calls) infos and leaves unexecuted ones marked).
+        have = {i.index for i in infos}
+        for idx, call in enumerate(p.calls):
+            if idx not in have:
+                infos.append(CallInfo(
+                    index=idx, num=call.meta.id, errno=-1,
+                    executed=False, fault_injected=False,
+                    signal=[], cover=[], comps=[]))
+        infos.sort(key=lambda i: i.index)
         return b"", infos, failed, hanged
 
     def _parse_out(self) -> List[CallInfo]:
@@ -282,7 +294,7 @@ class Env:
                 executed=bool(cflags & P.CALL_EXECUTED),
                 fault_injected=bool(cflags & P.CALL_FAULT_INJECTED),
                 signal=sig, cover=cov, comps=comps))
-        infos.sort(key=lambda i: i.index)
+        # exec() sorts after padding missing calls; no sort needed here
         return infos
 
 
