@@ -1,0 +1,72 @@
+"""Shared scaffolding for bundled per-OS description packages.
+
+Each descriptions/<os>/ package (linux, freebsd, fuchsia, windows) bundles
+description .txt files + consts_<arch>.json and registers a Target on
+demand — the role of the reference's generated sys/<os>/<arch>.go init()
+(reference: /root/reference/sys/linux/amd64.go:6-8).  The load/parse/
+compile/register flow is identical across OSes; only the arch hooks and
+(for vDSO/PE-dispatched OSes) the call-ordinal base differ.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Callable, Optional
+
+from ..prog.target import Target, register_target, _targets
+from .ast import CallDef
+from .compiler import compile_description
+from .parser import parse_files
+
+
+class UnsupportedArchError(KeyError):
+    """Raised when a bundled OS package has no consts for the arch."""
+
+
+def build_bundled_target(
+    os: str,
+    arch: str,
+    here: Path,
+    *,
+    init_arch: Callable[[Target], None],
+    ptr_size: int = 8,
+    page_size: int = 4 << 10,
+    data_offset: int = 512 << 20,
+    num_pages: int = 4 << 10,
+    ordinal_base: Optional[int] = None,
+) -> Target:
+    """Compile a bundled descriptions directory into a registered-ready Target.
+
+    ordinal_base: for OSes whose calls are dispatched by name (zircon vDSO,
+    PE imports) rather than numbered traps, assign each non-syz_* call a
+    stable ordinal `ordinal_base + index of call name in sorted order`
+    instead of requiring __NR_* consts.
+    """
+    consts_path = here / f"consts_{arch}.json"
+    if not consts_path.exists():
+        raise UnsupportedArchError(
+            f"{os}/{arch}: no bundled consts ({consts_path.name}); "
+            f"available: {sorted(p.name for p in here.glob('consts_*.json'))}")
+    consts = json.loads(consts_path.read_text())
+    desc = parse_files(sorted(here.glob("*.txt")))
+    if ordinal_base is not None:
+        names = sorted({n.call_name for n in desc.nodes
+                        if isinstance(n, CallDef)
+                        and not n.call_name.startswith("syz_")})
+        for i, name in enumerate(names):
+            consts.setdefault(f"__NR_{name}", ordinal_base + i)
+    target = compile_description(desc, consts, os=os, arch=arch,
+                                 ptr_size=ptr_size, page_size=page_size)
+    target.data_offset = data_offset
+    target.num_pages = num_pages
+    init_arch(target)
+    return target
+
+
+def ensure_bundled_registered(
+    os: str, arch: str, build: Callable[[str], Target]) -> Target:
+    key = f"{os}/{arch}"
+    if key not in _targets:
+        register_target(build(arch))
+    return _targets[key]

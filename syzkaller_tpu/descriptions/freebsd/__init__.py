@@ -1,9 +1,12 @@
-"""Bundled linux target: descriptions + consts + arch hooks.
+"""Bundled freebsd/amd64 target: descriptions + consts + arch hooks.
 
-Plays the role of the reference's generated sys/linux/<arch>.go +
-sys/linux/init.go (reference: /root/reference/sys/linux/init.go:12-60,148):
-compiles the bundled description files at first use and registers a Target
-with the mmap/sanitize hooks wired in.
+Plays the role of the reference's sys/freebsd target (generated
+sys/freebsd/amd64.go + hand-written init.go; reference:
+/root/reference/sys/freebsd/init.go:10-60): compiles the bundled
+description files at first use and registers a Target with the
+mmap hooks wired in.  FreeBSD's mmap call shape matches linux's
+six-argument form, so make_mmap/analyze_mmap mirror the linux hooks
+with FreeBSD flag values from consts_amd64.json.
 """
 
 from __future__ import annotations
@@ -17,19 +20,23 @@ from ..bundle import build_bundled_target, ensure_bundled_registered
 _HERE = Path(__file__).parent
 
 STRING_DICTIONARY = [
-    "user", "self", "proc", "sysfs", "cgroup", "tmpfs", "lo", "eth0",
-    "wlan0", "ppp0", "nodev", "security", "trusted", "system", "keyring",
-    "GPL", "md5sum", "mime_type",
+    "user", "wheel", "operator", "devfs", "procfs", "tmpfs", "nullfs",
+    "lo0", "em0", "tun0", "jail",
 ]
+
+# Signals that can't take down the executor process group: 0 (existence
+# test), SIGCHLD, SIGWINCH, SIGUSR1/2 are either ignored by default or
+# handled by the executor.  Everything else is rewritten by sanitize_call
+# (the linux corpus restricts kill the same way, linux/signal.txt).
+SAFE_SIGNALS = (0, 20, 28, 30, 31)
 
 
 def build_target(arch: str = "amd64") -> Target:
-    return build_bundled_target("linux", arch, _HERE, init_arch=_init_arch)
+    return build_bundled_target("freebsd", arch, _HERE, init_arch=_init_arch)
 
 
 def _init_arch(target: Target) -> None:
     mmap = target.syscall_map.get("mmap")
-    target.mmap_syscall = mmap
     cm = target.consts
     prot_rw = cm["PROT_READ"] | cm["PROT_WRITE"]
     map_flags = cm["MAP_ANONYMOUS"] | cm["MAP_PRIVATE"] | cm["MAP_FIXED"]
@@ -43,7 +50,7 @@ def _init_arch(target: Target) -> None:
                 progmod.ConstArg(mmap.args[1], npages * target.page_size),
                 progmod.ConstArg(mmap.args[2], prot_rw),
                 progmod.ConstArg(mmap.args[3], map_flags),
-                progmod.make_result_arg(mmap.args[4], None, invalid_fd),
+                progmod.ConstArg(mmap.args[4], invalid_fd),
                 progmod.ConstArg(mmap.args[5], 0),
             ],
             ret=progmod.ReturnArg(mmap.ret) if mmap.ret else progmod.ReturnArg(None),
@@ -53,33 +60,21 @@ def _init_arch(target: Target) -> None:
         name = c.meta.name
         if name == "mmap":
             npages = c.args[1].val // target.page_size
-            if npages == 0:
-                return 0, 0, False
-            flags = c.args[3].val
-            fd_val = getattr(c.args[4], "val", 0)
-            if flags & cm["MAP_ANONYMOUS"] == 0 and fd_val == invalid_fd:
-                return 0, 0, False
-            return c.args[0].page_index, npages, True
+            return c.args[0].page_index, npages, npages > 0
         if name == "munmap":
             return c.args[0].page_index, c.args[1].val // target.page_size, False
-        if name == "mremap":
-            return c.args[4].page_index, c.args[2].val // target.page_size, True
         return 0, 0, False
 
     def sanitize_call(c: progmod.Call) -> None:
         cn = c.meta.call_name
         if cn == "mmap":
-            # Force MAP_FIXED for deterministic replay.
             c.args[3].val |= cm["MAP_FIXED"]
-        elif cn == "mremap":
-            if c.args[3].val & cm["MREMAP_MAYMOVE"]:
-                c.args[3].val |= cm["MREMAP_FIXED"]
-        elif cn in ("exit", "exit_group"):
-            # Status codes 67/68 are reserved by the executor protocol.
-            if c.args and c.args[0].val % 128 in (67, 68):
-                c.args[0].val = 1
+        elif cn == "kill" and len(c.args) >= 2:
+            if c.args[1].val not in SAFE_SIGNALS:
+                c.args[1].val = 0
 
     if mmap is not None:
+        target.mmap_syscall = mmap
         target.make_mmap = make_mmap
         target.analyze_mmap = analyze_mmap
     target.sanitize_call = sanitize_call
@@ -87,4 +82,4 @@ def _init_arch(target: Target) -> None:
 
 
 def ensure_registered(arch: str = "amd64") -> Target:
-    return ensure_bundled_registered("linux", arch, build_target)
+    return ensure_bundled_registered("freebsd", arch, build_target)

@@ -1,0 +1,72 @@
+"""Bundled fuchsia/amd64 target: zircon descriptions + arch hooks.
+
+Plays the role of the reference's sys/fuchsia target (generated
+sys/fuchsia/{amd64,arm64}.go + init.go; reference:
+/root/reference/sys/fuchsia/init.go:10-50).  Zircon syscalls are vDSO
+entry points rather than numbered traps, so instead of `__NR_*` consts the
+target assigns each `zx_*` call a stable ordinal (VDSO_BASE + index of the
+call name in sorted order) — an executor for fuchsia dispatches through a
+name-indexed vDSO table exactly the way the reference's generated
+syscalls_fuchsia.h table does.  The memory bootstrap call is `syz_mmap`
+(maps zero-filled pages into the root vmar), matching the reference.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from ...prog import prog as progmod
+from ...prog.target import Target
+from ..bundle import build_bundled_target, ensure_bundled_registered
+
+_HERE = Path(__file__).parent
+
+VDSO_BASE = 1 << 20
+
+STRING_DICTIONARY = [
+    "zircon", "mxio", "devmgr", "svchost", "driver", "channel",
+]
+
+
+def build_target(arch: str = "amd64") -> Target:
+    return build_bundled_target("fuchsia", arch, _HERE,
+                                init_arch=_init_arch,
+                                ordinal_base=VDSO_BASE)
+
+
+def _init_arch(target: Target) -> None:
+    mmap = target.syscall_map.get("syz_mmap")
+
+    def make_mmap(start: int, npages: int) -> progmod.Call:
+        return progmod.Call(
+            meta=mmap,
+            args=[
+                progmod.PointerArg(mmap.args[0], start, 0, npages, None),
+                progmod.ConstArg(mmap.args[1], npages * target.page_size),
+            ],
+            ret=progmod.ReturnArg(mmap.ret) if mmap.ret else progmod.ReturnArg(None),
+        )
+
+    def analyze_mmap(c: progmod.Call):
+        if c.meta.name == "syz_mmap":
+            npages = c.args[1].val // target.page_size
+            return c.args[0].page_index, npages, npages > 0
+        return 0, 0, False
+
+    def sanitize_call(c: progmod.Call) -> None:
+        # Exit statuses 67/68 are reserved by the executor protocol
+        # (executor.cc kStatusFailed/kStatusHanged magic).
+        if c.meta.call_name == "zx_process_exit" and c.args:
+            if c.args[0].val % 128 in (67, 68):
+                c.args[0].val = 1
+
+    if mmap is not None:
+        target.mmap_syscall = mmap
+        target.make_mmap = make_mmap
+        target.analyze_mmap = analyze_mmap
+    target.sanitize_call = sanitize_call
+    target.string_dictionary = list(STRING_DICTIONARY)
+
+
+def ensure_registered(arch: str = "amd64") -> Target:
+    return ensure_bundled_registered("fuchsia", arch, build_target)

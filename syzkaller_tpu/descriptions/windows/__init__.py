@@ -1,0 +1,71 @@
+"""Bundled windows/amd64 target: Win32 descriptions + arch hooks.
+
+Plays the role of the reference's sys/windows target (generated
+sys/windows/amd64.go + init.go; reference:
+/root/reference/sys/windows/init.go:10-66).  VirtualAlloc is the target's
+mmap: make_mmap emits `VirtualAlloc(addr, size, MEM_COMMIT|MEM_RESERVE,
+PAGE_EXECUTE_READWRITE)` and analyze_mmap treats every VirtualAlloc as a
+mapping, mirroring the reference's makeMmap/analyzeMmap.  Win32 calls are
+dispatched by name through the PE import table, so the target assigns each
+call a stable ordinal (IMPORT_BASE + index in sorted order).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from ...prog import prog as progmod
+from ...prog.target import Target
+from ..bundle import build_bundled_target, ensure_bundled_registered
+
+_HERE = Path(__file__).parent
+
+IMPORT_BASE = 1 << 21
+
+STRING_DICTIONARY = [
+    "Global", "Local", "Software", "System", "CurrentControlSet",
+    "\\\\.\\pipe\\syz", "MACHINE",
+]
+
+
+def build_target(arch: str = "amd64") -> Target:
+    return build_bundled_target("windows", arch, _HERE,
+                                init_arch=_init_arch,
+                                ordinal_base=IMPORT_BASE)
+
+
+def _init_arch(target: Target) -> None:
+    valloc = target.syscall_map.get("VirtualAlloc")
+    cm = target.consts
+    alloc_type = cm["MEM_COMMIT"] | cm["MEM_RESERVE"]
+    prot = cm["PAGE_EXECUTE_READWRITE"]
+
+    def make_mmap(start: int, npages: int) -> progmod.Call:
+        return progmod.Call(
+            meta=valloc,
+            args=[
+                progmod.PointerArg(valloc.args[0], start, 0, npages, None),
+                progmod.ConstArg(valloc.args[1], npages * target.page_size),
+                progmod.ConstArg(valloc.args[2], alloc_type),
+                progmod.ConstArg(valloc.args[3], prot),
+            ],
+            ret=progmod.ReturnArg(valloc.ret) if valloc.ret else progmod.ReturnArg(None),
+        )
+
+    def analyze_mmap(c: progmod.Call):
+        if c.meta.name == "VirtualAlloc":
+            npages = c.args[1].val // target.page_size
+            return c.args[0].page_index, npages, npages > 0
+        if c.meta.name == "VirtualFree":
+            return c.args[0].page_index, c.args[1].val // target.page_size, False
+        return 0, 0, False
+
+    if valloc is not None:
+        target.mmap_syscall = valloc
+        target.make_mmap = make_mmap
+        target.analyze_mmap = analyze_mmap
+    target.string_dictionary = list(STRING_DICTIONARY)
+
+
+def ensure_registered(arch: str = "amd64") -> Target:
+    return ensure_bundled_registered("windows", arch, build_target)

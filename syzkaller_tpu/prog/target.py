@@ -155,10 +155,28 @@ def register_target(target: Target,
 def get_target(os: str, arch: str) -> Target:
     key = f"{os}/{arch}"
     if key not in _targets:
-        # Lazily build the bundled linux target from its descriptions.
-        if os == "linux":
-            from ..descriptions import linux as _linux  # noqa: F401
-            _linux.ensure_registered(arch)
+        # Lazily build a bundled target from its descriptions package
+        # (descriptions/<os>/ — linux, freebsd, fuchsia, windows), the
+        # role of the reference's sys/<os>/<arch>.go init() registration
+        # (reference: /root/reference/sys/linux/amd64.go:6-8).
+        import importlib
+
+        mod_name = f"{__package__.rsplit('.', 1)[0]}.descriptions.{os}"
+        try:
+            mod = importlib.import_module(mod_name)
+        except ModuleNotFoundError as e:
+            # Only an unknown OS is a lookup miss; a broken transitive
+            # import inside a descriptions package must propagate.
+            if e.name != mod_name:
+                raise
+            mod = None
+        if mod is not None:
+            try:
+                mod.ensure_registered(arch)
+            except KeyError:
+                # UnsupportedArchError: fall through to the uniform
+                # unknown-target report below.
+                pass
         if key not in _targets:
             raise KeyError(
                 f"unknown target {key} (known: {sorted(_targets)})")
