@@ -226,9 +226,56 @@ _HOST_ARCH = {"x86_64": "amd64", "aarch64": "arm64", "i686": "386",
               "i386": "386", "ppc64le": "ppc64le", "riscv64": "riscv64"}
 
 
+_ASM_GENERIC = Path("/usr/include/asm-generic")
+
+
+def derive_arm64(base: Dict[str, int]) -> Dict[str, int]:
+    """Derive linux/arm64 consts from amd64 ones + asm-generic headers.
+
+    arm64 takes its syscall table and fcntl flag values verbatim from the
+    asm-generic headers (arch/arm64/include/uapi/asm/unistd.h is a
+    one-line include of asm-generic/unistd.h), so those headers — present
+    on any linux host — are the authoritative arm64 ABI even without an
+    aarch64 cross compiler.  Everything else (socket/ioctl/mman/signal
+    values) is identical between the two arches, both already using the
+    asm-generic definitions.  Legacy calls with no arm64 trap (open, pipe,
+    dup2, rename, poll, ...) get no __NR_* entry and stay unsupported at
+    compile time, matching real arm64 kernels.
+    """
+    out = {k: v for k, v in base.items() if not k.startswith("__NR_")}
+
+    nr_re = re.compile(r"#define\s+(__NR3264_|__NR_)(\w+)\s+(\d+)\s*$",
+                       re.MULTILINE)
+    unistd = (_ASM_GENERIC / "unistd.h").read_text()
+    for _, name, num in nr_re.findall(unistd):
+        out.setdefault(f"__NR_{name}", int(num))
+
+    # Same trap, different name: amd64's newfstatat is asm-generic's
+    # fstatat (__NR3264_fstatat).
+    if "__NR_fstatat" in out:
+        out.setdefault("__NR_newfstatat", out["__NR_fstatat"])
+
+    # asm-generic open flags are octal; x86 happens to share them, but
+    # arches like mips/parisc override — parse rather than assume.
+    o_re = re.compile(
+        r"#define\s+(O_\w+|F_\w+)\s+(0x[0-9a-fA-F]+|0[0-7]*|[1-9]\d*)")
+    fcntl = (_ASM_GENERIC / "fcntl.h").read_text()
+    for name, val in o_re.findall(fcntl):
+        if name in base:
+            # C-style literals: 0x... hex, 0... octal, else decimal.
+            if val.startswith("0x"):
+                out[name] = int(val, 16)
+            elif val.startswith("0") and len(val) > 1:
+                out[name] = int(val, 8)
+            else:
+                out[name] = int(val)
+    return out
+
+
 def main(argv: List[str]) -> int:
     arch = "amd64"
     cc = None
+    derive = False
     args = []
     it = iter(argv)
     for a in it:
@@ -236,8 +283,18 @@ def main(argv: List[str]) -> int:
             arch = next(it)
         elif a == "--cc":
             cc = next(it)
+        elif a == "--derive-arm64":
+            derive = True
         else:
             args.append(a)
+    if derive:
+        here = Path(__file__).parent / "linux"
+        base = json.loads((here / "consts_amd64.json").read_text())
+        vals = derive_arm64(base)
+        out_path = here / "consts_arm64.json"
+        out_path.write_text(json.dumps(vals, indent=1, sort_keys=True) + "\n")
+        print(f"derived {len(vals)} consts -> {out_path}")
+        return 0
     import platform
 
     host = _HOST_ARCH.get(platform.machine(), platform.machine())

@@ -171,11 +171,13 @@ def get_target(os: str, arch: str) -> Target:
                 raise
             mod = None
         if mod is not None:
+            from ..descriptions.bundle import UnsupportedArchError
+
             try:
                 mod.ensure_registered(arch)
-            except KeyError:
-                # UnsupportedArchError: fall through to the uniform
-                # unknown-target report below.
+            except UnsupportedArchError:
+                # No bundled consts for this arch: fall through to the
+                # uniform unknown-target report below.
                 pass
         if key not in _targets:
             raise KeyError(

@@ -76,6 +76,26 @@ def test_choice_table(target):
         assert 0 <= idx < len(target.syscalls)
 
 
+def test_linux_arm64():
+    """linux/arm64: asm-generic syscall numbering, no legacy traps."""
+    t = get_target("linux", "arm64")
+    a = get_target("linux", "amd64")
+    nr = {s.name: s.nr for s in t.syscalls}
+    # asm-generic numbers (arch/arm64 includes asm-generic/unistd.h).
+    assert nr["openat"] == 56
+    assert nr["mmap"] == 222
+    assert nr["read"] == 63
+    # Legacy calls without an arm64 trap must be absent, not mis-numbered.
+    assert "open" not in nr and "pipe" not in nr and "poll" not in nr
+    # Flag values shared with amd64 (both use asm-generic headers).
+    assert t.consts["O_DIRECTORY"] == a.consts["O_DIRECTORY"]
+    for seed in range(10):
+        p = generate(t, seed, 8, None)
+        text = serialize(p)
+        assert serialize(deserialize(t, text)) == text
+        assert serialize_for_exec(p, 0)
+
+
 def test_cross_os_isolation():
     """Targets must not leak state across OSes (distinct registries)."""
     a = get_target("freebsd", "amd64")
