@@ -355,7 +355,7 @@ class Compiler:
             return replace(base, name="fileoff", kind=IntKind.FILEOFF,
                            field_name=fname, optional=opt)
 
-        if name == "vma":
+        if name in ("vma", "vma64"):
             rb = re_ = 0
             if args:
                 a = args[0]
@@ -364,7 +364,9 @@ class Compiler:
                     re_ = self._const(a.end, fname)
                 else:
                     rb = re_ = self._const(a, fname)
-            return VmaType(name="vma", field_name=fname, size=self.ptr_size,
+            # vma64 is 8 bytes on every arch (reference prog/types.go VmaType).
+            size = 8 if name == "vma64" else self.ptr_size
+            return VmaType(name=name, field_name=fname, size=size,
                            dir=dir, optional=opt, range_begin=rb, range_end=re_)
 
         if name == "ptr":

@@ -244,31 +244,44 @@ def derive_arm64(base: Dict[str, int]) -> Dict[str, int]:
     """
     out = {k: v for k, v in base.items() if not k.startswith("__NR_")}
 
-    nr_re = re.compile(r"#define\s+(__NR3264_|__NR_)(\w+)\s+(\d+)\s*$",
-                       re.MULTILINE)
-    unistd = (_ASM_GENERIC / "unistd.h").read_text()
-    for _, name, num in nr_re.findall(unistd):
-        out.setdefault(f"__NR_{name}", int(num))
+    # Run the real preprocessor over asm-generic/unistd.h with arm64's
+    # configuration (__BITS_PER_LONG=64 plus the __ARCH_WANT_* switches
+    # arm64's uapi unistd.h sets), so 32-bit-only traps (clock_gettime64,
+    # futex_time64, ...) and unconfigured optional ones are excluded by
+    # their #if guards instead of leaking into the table.
+    cpp = subprocess.run(
+        ["gcc", "-E", "-dM", "-x", "c",
+         "-D__BITS_PER_LONG=64",
+         "-D__ARCH_WANT_NEW_STAT", "-D__ARCH_WANT_RENAMEAT",
+         "-D__ARCH_WANT_SET_GET_RLIMIT", "-D__ARCH_WANT_SYS_CLONE3",
+         "-D__ARCH_WANT_MEMFD_SECRET",
+         str(_ASM_GENERIC / "unistd.h")],
+        capture_output=True, text=True, check=True).stdout
+    defs: Dict[str, str] = {}
+    for m in re.finditer(r"#define\s+(__NR3264_\w+|__NR_\w+)\s+(\S+)", cpp):
+        defs[m.group(1)] = m.group(2)
+    for name, val in defs.items():
+        if not name.startswith("__NR_"):
+            continue
+        val = defs.get(val, val)  # __NR_mmap -> __NR3264_mmap -> 222
+        if val.isdigit():
+            out.setdefault(name, int(val))
+    out.pop("__NR_syscalls", None)  # table size, not a trap
+    out.pop("__NR_arch_specific_syscall", None)
 
     # Same trap, different name: amd64's newfstatat is asm-generic's
     # fstatat (__NR3264_fstatat).
     if "__NR_fstatat" in out:
         out.setdefault("__NR_newfstatat", out["__NR_fstatat"])
 
-    # asm-generic open flags are octal; x86 happens to share them, but
-    # arches like mips/parisc override — parse rather than assume.
-    o_re = re.compile(
-        r"#define\s+(O_\w+|F_\w+)\s+(0x[0-9a-fA-F]+|0[0-7]*|[1-9]\d*)")
-    fcntl = (_ASM_GENERIC / "fcntl.h").read_text()
-    for name, val in o_re.findall(fcntl):
-        if name in base:
-            # C-style literals: 0x... hex, 0... octal, else decimal.
-            if val.startswith("0x"):
-                out[name] = int(val, 16)
-            elif val.startswith("0") and len(val) > 1:
-                out[name] = int(val, 8)
-            else:
-                out[name] = int(val)
+    # arm64 does NOT take fcntl flags from asm-generic: it inherits arm's
+    # arch overrides (arch/arm64/include/uapi/asm/fcntl.h) for these four.
+    out.update({
+        "O_DIRECTORY": 0o40000,
+        "O_NOFOLLOW": 0o100000,
+        "O_DIRECT": 0o200000,
+        "O_LARGEFILE": 0o400000,
+    })
     return out
 
 

@@ -64,6 +64,8 @@
 
 typedef uint64_t uint64;
 typedef uint32_t uint32;
+typedef uint16_t uint16;
+typedef uint8_t uint8;
 
 // ---------------- protocol constants (mirrored in ipc/protocol.py) ---------
 
@@ -103,6 +105,8 @@ const uint64 kArgConst = 0;
 const uint64 kArgResult = 1;
 const uint64 kArgData = 2;
 const uint64 kArgCsum = 3;
+const uint64 kCsumChunkData = 0;
+const uint64 kCsumChunkConst = 1;
 
 const uint64 kPseudoNrBase = 1ull << 30;  // descriptions/compiler.py:58
 
@@ -844,17 +848,38 @@ static uint64 read_arg(parser_t* p, uint64 copyin_addr) {
       return (uint64)scratch;
     }
     case kArgCsum: {
-      // Checksums are computed by the serializer on the host in this build
-      // (prog/checksum semantics); consume and ignore chunk descriptors.
-      p->next();  // size
-      p->next();  // csum kind
+      // Ones'-complement internet checksum over a chunk list (data ranges
+      // already copied into guest memory + pseudo-header constants), stored
+      // big-endian into the csum field (prog/checksum.py emits these).
+      uint64 size = p->next();
+      p->next();  // csum kind: only inet accumulation exists on the wire
       uint64 nchunks = p->next();
+      uint32 acc = 0;
       for (uint64 i = 0; i < nchunks; i++) {
-        p->next();
-        p->next();
-        p->next();
+        uint64 chunk_kind = p->next();
+        uint64 value = p->next();
+        uint64 chunk_size = p->next();
+        if (chunk_kind == kCsumChunkConst) {
+          acc += (uint32)(value & 0xffff);
+        } else {
+          NONFAILING({
+            const uint8* d = (const uint8*)value;
+            for (uint64 j = 0; j + 1 < chunk_size; j += 2)
+              acc += ((uint32)d[j] << 8) | d[j + 1];
+            if (chunk_size & 1) acc += (uint32)d[chunk_size - 1] << 8;
+          });
+        }
+        while (acc >> 16) acc = (acc & 0xffff) + (acc >> 16);
       }
-      return 0;
+      uint16 csum = (uint16)~acc;
+      if (copyin_addr) {
+        NONFAILING({
+          char* a = (char*)copyin_addr;
+          a[0] = (char)(csum >> 8);
+          if (size >= 2) a[1] = (char)(csum & 0xff);
+        });
+      }
+      return csum;
     }
     default:
       p->ok = false;

@@ -1,0 +1,152 @@
+"""Checksum dependency computation at exec-serialization time.
+
+Plays the role of the reference's prog/checksum.go (calcChecksumsCall,
+reference prog/checksum.go:29-160): csum-typed fields are generated and
+copied in as zero, and each one yields an extra exec instruction telling
+the executor how to compute the real value *after* all copyins land —
+a list of (data-range | constant) chunks summed with the ones'-complement
+internet checksum and stored back into the field.
+
+Chunk semantics:
+- ``csum[BUF, inet, intN]`` — one data chunk covering BUF's bytes, where
+  BUF is a sibling field of the csum field or the literal name ``parent``
+  for the enclosing struct (whose own csum field is zero during the sum,
+  which is exactly the IP-header convention).
+- ``csum[BUF, pseudo, PROTO, intN]`` — the TCP/UDP pseudo-header: data
+  chunks for the ``src_ip``/``dst_ip`` fields of the nearest enclosing
+  struct that has both (IPv4 or IPv6 shapes both work since sizes come
+  from the fields), constant chunks for PROTO and BUF's byte length, then
+  BUF's data chunk.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+from .prog import Arg, GroupArg, ReturnArg, UnionArg
+from .types import CsumKind, CsumType, StructType
+
+CHUNK_DATA = 0
+CHUNK_CONST = 1
+
+
+@dataclass
+class Chunk:
+    kind: int      # CHUNK_DATA | CHUNK_CONST
+    value: int     # offset from pointee base (DATA) or be16 value (CONST)
+    size: int      # bytes covered (DATA) or const width (CONST)
+
+
+@dataclass
+class CsumInstr:
+    offset: int    # byte offset of the csum field from the pointee base
+    size: int      # width of the csum field
+    chunks: List[Chunk]
+
+
+def _walk(arg: Arg, offset: int, stack, out) -> int:
+    """Mirror of foreach_subarg_offset (prog.py:254-278) that also records
+    the ancestor group stack for each visited arg.  The return value must
+    advance exactly like foreach_subarg_offset's rec() — struct and array
+    groups return the accumulated field offset (no trailing align padding),
+    since that is where the copyins actually placed the bytes."""
+    if isinstance(arg, GroupArg):
+        stack.append((arg, offset))
+        off = offset
+        if isinstance(arg.typ, StructType):
+            for f in arg.inner:
+                _walk(f, off, stack, out)
+                if not f.typ.bitfield_middle:
+                    off += f.size()
+        else:
+            for e in arg.inner:
+                off = _walk(e, off, stack, out)
+        stack.pop()
+        return off
+    if isinstance(arg, UnionArg):
+        stack.append((arg, offset))
+        _walk(arg.option, offset, stack, out)
+        stack.pop()
+        return offset + arg.size()
+    if isinstance(arg, ReturnArg):
+        return offset
+    if isinstance(arg.typ, CsumType):
+        out.append((arg, offset, list(stack)))
+    return offset + arg.size()
+
+
+def _find_field(group: GroupArg, base: int, name: str) \
+        -> Optional[Tuple[Arg, int]]:
+    if not isinstance(group.typ, StructType):
+        return None
+    off = base
+    for f in group.inner:
+        if f.typ.field_name == name:
+            return f, off
+        if not f.typ.bitfield_middle:
+            off += f.size()
+    return None
+
+
+def calc_checksums(pointee: Arg) -> List[CsumInstr]:
+    """Compute csum instructions for one copied-in pointee tree.
+
+    Offsets are relative to the pointee base; the exec serializer adds the
+    physical address.  Unresolvable references (no such sibling, no
+    enclosing src_ip/dst_ip) degrade to no instruction — the field just
+    stays zero, matching the reference's leniency for partially-formed
+    mutants.
+    """
+    found: List[Tuple[Arg, int, list]] = []
+    _walk(pointee, 0, [], found)
+    out: List[CsumInstr] = []
+    for arg, off, stack in found:
+        typ: CsumType = arg.typ
+        groups = [(g, goff) for g, goff in stack if isinstance(g, GroupArg)]
+        if not groups:
+            continue
+        # Resolve BUF: "parent" = enclosing struct; else nearest ancestor
+        # struct owning a field of that name.
+        target: Optional[Tuple[Arg, int]] = None
+        if typ.buf == "parent":
+            target = groups[-1]
+        else:
+            for g, goff in reversed(groups):
+                target = _find_field(g, goff, typ.buf)
+                if target is not None:
+                    break
+        if target is None:
+            continue
+        buf_arg, buf_off = target
+        chunks: List[Chunk] = []
+        if typ.kind == CsumKind.PSEUDO:
+            src = dst = None
+            for g, goff in reversed(groups):
+                src = _find_field(g, goff, "src_ip")
+                dst = _find_field(g, goff, "dst_ip")
+                if src is not None and dst is not None:
+                    break
+                src = dst = None
+            if src is None or dst is None:
+                continue
+            chunks.append(Chunk(CHUNK_DATA, src[1], src[0].size()))
+            chunks.append(Chunk(CHUNK_DATA, dst[1], dst[0].size()))
+            chunks.append(Chunk(CHUNK_CONST, typ.protocol, 2))
+            chunks.append(Chunk(CHUNK_CONST, buf_arg.size(), 2))
+        chunks.append(Chunk(CHUNK_DATA, buf_off, buf_arg.size()))
+        out.append(CsumInstr(offset=off, size=arg.size(), chunks=chunks))
+    return out
+
+
+def ip_checksum(data: bytes, extra: int = 0) -> int:
+    """Host-side reference of the executor's computation (for csource and
+    tests): ones'-complement sum of big-endian 16-bit words."""
+    acc = extra
+    if len(data) % 2:
+        data = data + b"\x00"
+    for i in range(0, len(data), 2):
+        acc += (data[i] << 8) | data[i + 1]
+    while acc >> 16:
+        acc = (acc & 0xFFFF) + (acc >> 16)
+    return (~acc) & 0xFFFF

@@ -28,6 +28,7 @@ from .prog import (
     foreach_subarg,
     foreach_subarg_offset,
 )
+from .checksum import calc_checksums
 from .types import CsumType, Dir, PtrType, UINT64_MAX, VmaType, is_pad
 
 # Instruction markers (top of the u64 space, descending).
@@ -156,6 +157,31 @@ def serialize_for_exec(p: Prog, pid: int = 0,
 
         for a in c.args:
             foreach_subarg(a, gen_copyins)
+
+        # --- checksum instructions (after the data they sum over) ---
+        def gen_csums(arg: Arg, _base):
+            nonlocal instr_seq
+            if not isinstance(arg, PointerArg) or arg.res is None:
+                return
+            base_addr = physical_addr(target, arg)
+            for ci in calc_checksums(arg.res):
+                w.word(EXEC_INSTR_COPYIN)
+                w.word(base_addr + ci.offset)
+                w.word(EXEC_ARG_CSUM)
+                w.word(ci.size)
+                w.word(EXEC_ARG_CSUM_INET)
+                w.word(len(ci.chunks))
+                for ch in ci.chunks:
+                    w.word(ch.kind)
+                    if ch.kind == EXEC_ARG_CSUM_CHUNK_DATA:
+                        w.word(base_addr + ch.value)
+                    else:
+                        w.word(ch.value)
+                    w.word(ch.size)
+                instr_seq += 1
+
+        for a in c.args:
+            foreach_subarg(a, gen_csums)
 
         # --- the call itself ---
         w.word(c.meta.id)

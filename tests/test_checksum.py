@@ -1,0 +1,99 @@
+"""Checksum dependency tests (reference prog/checksum.go semantics):
+csum-typed fields yield exec instructions whose chunks the executor sums
+with the ones'-complement internet checksum after copyins land.
+"""
+
+import random
+
+from syzkaller_tpu.prog import get_target
+from syzkaller_tpu.prog.checksum import (
+    CHUNK_CONST,
+    CHUNK_DATA,
+    calc_checksums,
+    ip_checksum,
+)
+from syzkaller_tpu.prog.encoding import deserialize, serialize
+from syzkaller_tpu.prog.encodingexec import decode_exec, serialize_for_exec
+from syzkaller_tpu.prog.generation import generate
+from syzkaller_tpu.prog.mutation import mutate
+
+
+def target():
+    return get_target("linux", "amd64")
+
+
+def _emit_prog(variant):
+    t = target()
+    from syzkaller_tpu.prog.generation import RandGen
+
+    meta = t.syscall_map[variant]
+    r = RandGen(t, seed=5)
+    from syzkaller_tpu.prog.analysis import analyze
+    from syzkaller_tpu.prog.prog import Prog
+
+    p = Prog(t)
+    s = analyze(None, p, None)
+    calls = r.generate_particular_call(s, meta)
+    for c in calls:
+        p.calls.append(c)
+    return p
+
+
+def test_ipv4_header_csum_instruction():
+    p = _emit_prog("syz_emit_ethernet$ipv4_tcp")
+    data = serialize_for_exec(p, 0)
+    instrs = decode_exec(data)
+    csums = [i for i in instrs
+             if i["op"] == "copyin" and i["arg"]["kind"] == "csum"]
+    # One inet header csum + one tcp pseudo csum.
+    assert len(csums) == 2
+    inet = [c for c in csums if len(c["arg"]["chunks"]) == 1]
+    pseudo = [c for c in csums if len(c["arg"]["chunks"]) == 5]
+    assert len(inet) == 1 and len(pseudo) == 1
+    # Pseudo chunks: src_ip, dst_ip, proto const, length const, payload.
+    kinds = [ch["kind"] for ch in pseudo[0]["arg"]["chunks"]]
+    assert kinds == [CHUNK_DATA, CHUNK_DATA, CHUNK_CONST, CHUNK_CONST,
+                     CHUNK_DATA]
+    proto = pseudo[0]["arg"]["chunks"][2]["value"]
+    assert proto == 6  # IPPROTO_TCP
+
+
+def test_udp_pseudo_proto():
+    p = _emit_prog("syz_emit_ethernet$ipv4_udp")
+    instrs = decode_exec(serialize_for_exec(p, 0))
+    csums = [i for i in instrs
+             if i["op"] == "copyin" and i["arg"]["kind"] == "csum"]
+    pseudo = [c for c in csums if len(c["arg"]["chunks"]) == 5]
+    assert pseudo and pseudo[0]["arg"]["chunks"][2]["value"] == 0x11
+
+
+def test_ip_checksum_reference_values():
+    # RFC 1071 worked example.
+    data = bytes([0x00, 0x01, 0xf2, 0x03, 0xf4, 0xf5, 0xf6, 0xf7])
+    assert ip_checksum(data) == (~0xddf2) & 0xFFFF
+    # Checksum of a block including its own correct checksum verifies to 0.
+    c = ip_checksum(data)
+    whole = data + bytes([c >> 8, c & 0xFF])
+    assert ip_checksum(whole) == 0
+    # Odd length pads with zero.
+    assert ip_checksum(b"\x01") == (~0x0100) & 0xFFFF
+
+
+def test_calc_checksums_degrades_gracefully():
+    """Mutants that break the packet shape must not crash serialization."""
+    t = target()
+    rng = random.Random(0)
+    for seed in range(30):
+        p = generate(t, seed, 6, None)
+        mutate(p, seed, ncalls=8, ct=None, corpus=[])
+        serialize_for_exec(p, 0)  # must not raise
+
+
+def test_vnet_roundtrip():
+    t = target()
+    for variant in ["syz_emit_ethernet$arp", "syz_emit_ethernet$ipv6_udp",
+                    "syz_emit_ethernet$ipv4_icmp"]:
+        p = _emit_prog(variant)
+        text = serialize(p)
+        p2 = deserialize(t, text)
+        assert serialize(p2) == text

@@ -87,8 +87,13 @@ def test_linux_arm64():
     assert nr["read"] == 63
     # Legacy calls without an arm64 trap must be absent, not mis-numbered.
     assert "open" not in nr and "pipe" not in nr and "poll" not in nr
-    # Flag values shared with amd64 (both use asm-generic headers).
-    assert t.consts["O_DIRECTORY"] == a.consts["O_DIRECTORY"]
+    # arm64 inherits arm's fcntl overrides — different from amd64's.
+    assert t.consts["O_DIRECTORY"] == 0o40000
+    assert t.consts["O_DIRECT"] == 0o200000
+    assert a.consts["O_DIRECTORY"] != t.consts["O_DIRECTORY"]
+    # 32-bit-only traps must not leak into the 64-bit table.
+    assert "__NR_clock_gettime64" not in t.consts
+    assert "__NR_futex_time64" not in t.consts
     for seed in range(10):
         p = generate(t, seed, 8, None)
         text = serialize(p)
