@@ -346,6 +346,12 @@ class Compiler:
             else:
                 raise CompileError(f"{te.pos}: bad csum kind {kind_name}")
             base = self._base_type(rest, dir, fname)
+            if not base.big_endian:
+                # The executor stores checksums big-endian and the wire
+                # format carries no endianness; network checksums are
+                # network-order by definition, so require intNbe.
+                raise CompileError(
+                    f"{te.pos}: csum base type must be big-endian (int16be)")
             return CsumType(name="csum", field_name=fname, size=base.size,
                             dir=dir, big_endian=base.big_endian, kind=kind,
                             buf=args[0].name, protocol=protocol)

@@ -140,6 +140,11 @@ def collect_idents(desc: ast.Description) -> Tuple[Set[str], Set[str], List[str]
 
 def _probe_source(names: List[str], includes: Iterable[str]) -> str:
     lines = ["#define _GNU_SOURCE"]
+    # Kernel uapi headers routinely assume the libc base types are already
+    # in scope (uint8_t, struct sockaddr_storage, ...), so the preamble
+    # must precede the description's own include list.
+    for inc in ("stdint.h", "stddef.h", "sys/types.h", "sys/socket.h"):
+        lines.append(f"#include <{inc}>")
     for inc in includes:
         lines.append(f"#include <{inc}>")
     lines.append("#include <stdio.h>")

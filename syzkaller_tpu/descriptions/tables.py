@@ -293,8 +293,11 @@ def compile_tables(target) -> CompiledTables:
                                    fname=t.field_name))
                 return t.size if not t.bitfield_middle else 0
             if isinstance(t, CsumType):
+                # SK_LEN: recomputed (by the executor at run time), never
+                # mutated — a device-proposed value would poison the inet
+                # sum, whose buf range includes this field as zero.
                 ti = type_row(t, TK_CSUM)
-                slots.append(_Slot(ti, SK_VALUE, is_arg, arg_idx, block,
+                slots.append(_Slot(ti, SK_LEN, is_arg, arg_idx, block,
                                    offset, t.size, group=group,
                                    fname=t.field_name))
                 return t.size

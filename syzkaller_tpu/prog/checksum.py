@@ -24,7 +24,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import List, Optional, Tuple
 
-from .prog import Arg, GroupArg, ReturnArg, UnionArg
+from .prog import Arg, GroupArg, foreach_subarg_offset
 from .types import CsumKind, CsumType, StructType
 
 CHUNK_DATA = 0
@@ -45,45 +45,37 @@ class CsumInstr:
     chunks: List[Chunk]
 
 
-def _walk(arg: Arg, offset: int, stack, out) -> int:
-    """Mirror of foreach_subarg_offset (prog.py:254-278) that also records
-    the ancestor group stack for each visited arg.  The return value must
-    advance exactly like foreach_subarg_offset's rec() — struct and array
-    groups return the accumulated field offset (no trailing align padding),
-    since that is where the copyins actually placed the bytes."""
-    if isinstance(arg, GroupArg):
-        stack.append((arg, offset))
-        off = offset
-        if isinstance(arg.typ, StructType):
-            for f in arg.inner:
-                _walk(f, off, stack, out)
-                if not f.typ.bitfield_middle:
-                    off += f.size()
-        else:
-            for e in arg.inner:
-                off = _walk(e, off, stack, out)
-        stack.pop()
-        return off
-    if isinstance(arg, UnionArg):
-        stack.append((arg, offset))
-        _walk(arg.option, offset, stack, out)
-        stack.pop()
-        return offset + arg.size()
-    if isinstance(arg, ReturnArg):
-        return offset
-    if isinstance(arg.typ, CsumType):
-        out.append((arg, offset, list(stack)))
-    return offset + arg.size()
+def _find_csums(pointee: Arg) -> List[Tuple[Arg, int, list]]:
+    """Collect (csum_arg, offset, ancestor_stack) using the one layout
+    authority, foreach_subarg_offset's enter/leave hooks."""
+    stack: list = []
+    out: List[Tuple[Arg, int, list]] = []
+
+    def fn(a: Arg, off: int) -> None:
+        if isinstance(getattr(a, "typ", None), CsumType):
+            out.append((a, off, list(stack)))
+
+    foreach_subarg_offset(
+        pointee, fn,
+        enter=lambda a, off: stack.append((a, off)),
+        leave=lambda a: stack.pop())
+    return out
 
 
-def _find_field(group: GroupArg, base: int, name: str) \
+def _find_field(group: GroupArg, base: int, name: str, deep: bool = False) \
         -> Optional[Tuple[Arg, int]]:
+    """Find a field by name in a struct; with deep=True also look one level
+    into nested struct fields (an IPv4 header struct inside the packet)."""
     if not isinstance(group.typ, StructType):
         return None
     off = base
     for f in group.inner:
         if f.typ.field_name == name:
             return f, off
+        if deep and isinstance(f, GroupArg) and isinstance(f.typ, StructType):
+            sub = _find_field(f, off, name)
+            if sub is not None:
+                return sub
         if not f.typ.bitfield_middle:
             off += f.size()
     return None
@@ -98,8 +90,7 @@ def calc_checksums(pointee: Arg) -> List[CsumInstr]:
     stays zero, matching the reference's leniency for partially-formed
     mutants.
     """
-    found: List[Tuple[Arg, int, list]] = []
-    _walk(pointee, 0, [], found)
+    found = _find_csums(pointee)
     out: List[CsumInstr] = []
     for arg, off, stack in found:
         typ: CsumType = arg.typ
@@ -121,10 +112,13 @@ def calc_checksums(pointee: Arg) -> List[CsumInstr]:
         buf_arg, buf_off = target
         chunks: List[Chunk] = []
         if typ.kind == CsumKind.PSEUDO:
+            # The IP addresses may sit directly in an ancestor (IPv6
+            # packet shape) or inside its nested header struct (IPv4
+            # shape) — search one level deep.
             src = dst = None
             for g, goff in reversed(groups):
-                src = _find_field(g, goff, "src_ip")
-                dst = _find_field(g, goff, "dst_ip")
+                src = _find_field(g, goff, "src_ip", deep=True)
+                dst = _find_field(g, goff, "dst_ip", deep=True)
                 if src is not None and dst is not None:
                     break
                 src = dst = None

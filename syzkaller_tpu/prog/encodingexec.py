@@ -101,7 +101,10 @@ def serialize_for_exec(p: Prog, pid: int = 0,
         if isinstance(arg, ConstArg):
             w.word(EXEC_ARG_CONST)
             w.word(arg.size())
-            w.word(arg.value(pid))
+            # csum fields must land as zero: the executor's checksum
+            # instruction sums the enclosing range with this field included
+            # before overwriting it (a stray value would poison the sum).
+            w.word(0 if isinstance(arg.typ, CsumType) else arg.value(pid))
             w.word(arg.typ.bitfield_offset)
             w.word(arg.typ.bitfield_length)
         elif isinstance(arg, ResultArg):

@@ -251,13 +251,21 @@ def foreach_arg(call: Call, fn: Callable[[Arg, Optional[Arg]], None]) -> None:
         foreach_subarg(a, fn)
 
 
-def foreach_subarg_offset(arg: Arg, fn: Callable[[Arg, int], None]) -> None:
+def foreach_subarg_offset(arg: Arg, fn: Callable[[Arg, int], None],
+                          enter: Optional[Callable[[Arg, int], None]] = None,
+                          leave: Optional[Callable[[Arg], None]] = None) -> None:
     """Traverse a pointee subtree with byte offsets of each sub-arg from the
-    start of `arg` (mirrors copyin layout; reference prog/analysis.go)."""
+    start of `arg` (mirrors copyin layout; reference prog/analysis.go).
+
+    `enter`/`leave` fire around group/union containers so callers that need
+    the ancestor chain (prog/checksum.py) share this one layout authority
+    instead of re-implementing the offset rules."""
 
     def rec(a: Arg, offset: int) -> int:
         fn(a, offset)
         if isinstance(a, GroupArg):
+            if enter is not None:
+                enter(a, offset)
             if isinstance(a.typ, StructType):
                 for f in a.inner:
                     rec(f, offset)
@@ -267,9 +275,15 @@ def foreach_subarg_offset(arg: Arg, fn: Callable[[Arg, int], None]) -> None:
             else:  # array
                 for e in a.inner:
                     offset = rec(e, offset)
+            if leave is not None:
+                leave(a)
             return offset
         if isinstance(a, UnionArg):
+            if enter is not None:
+                enter(a, offset)
             rec(a.option, offset)
+            if leave is not None:
+                leave(a)
             return offset + a.size()
         if isinstance(a, ReturnArg):
             return offset

@@ -159,10 +159,14 @@ def walk_slots(args: List[Arg], budget: Optional[List[int]] = None
         if isinstance(t, ResourceType):
             budget[0] -= 1
             yield arg, (SK_REF if t.dir == Dir.IN else SK_VALUE)
-        elif isinstance(t, LenType):
+        elif isinstance(t, (LenType, CsumType)):
+            # Both are recomputed, never mutated: sizes by
+            # assign_sizes_call, checksums by the executor at run time
+            # (a device-proposed csum value would poison the inet sum,
+            # whose buf range includes the field itself as zero).
             budget[0] -= 1
             yield arg, SK_LEN
-        elif isinstance(t, (IntType, FlagsType, ProcType, CsumType)):
+        elif isinstance(t, (IntType, FlagsType, ProcType)):
             budget[0] -= 1
             yield arg, SK_VALUE
         elif isinstance(t, ConstType):

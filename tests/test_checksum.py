@@ -50,6 +50,9 @@ def test_ipv4_header_csum_instruction():
     inet = [c for c in csums if len(c["arg"]["chunks"]) == 1]
     pseudo = [c for c in csums if len(c["arg"]["chunks"]) == 5]
     assert len(inet) == 1 and len(pseudo) == 1
+    # The header csum must cover exactly the 20-byte IPv4 header — not the
+    # payload — or the kernel's ip_rcv drops every injected frame.
+    assert inet[0]["arg"]["chunks"][0]["size"] == 20
     # Pseudo chunks: src_ip, dst_ip, proto const, length const, payload.
     kinds = [ch["kind"] for ch in pseudo[0]["arg"]["chunks"]]
     assert kinds == [CHUNK_DATA, CHUNK_DATA, CHUNK_CONST, CHUNK_CONST,

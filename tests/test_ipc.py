@@ -63,6 +63,7 @@ def test_errno_reported(target, env):
 
 
 def test_generated_progs_roundtrip(target, env):
+    completed = 0
     for seed in range(20):
         p = generate(target, seed, 8)
         _, infos, failed, hanged = env.exec(ExecOpts(), p)
@@ -72,10 +73,14 @@ def test_generated_progs_roundtrip(target, env):
         assert not failed, f"seed {seed}"
         if hanged:
             continue
+        completed += 1
         assert len(infos) == len(p.calls)
         for i, info in enumerate(infos):
             assert info.index == i
             assert info.num == p.calls[i].meta.id
+    # Blocking calls are rare; an executor that hangs on everything is
+    # broken, not tolerant.
+    assert completed >= 15
 
 
 def test_threaded_and_collide(target, env):
