@@ -9,6 +9,9 @@ import sys
 # plugin unconditionally); tests must stay hermetic on the virtual CPU
 # mesh, so update the jax config directly as well.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Run pallas kernels through the interpreter on the CPU test backend
+# (ops/pallas_cover.py gates on this; production CPU falls back to jnp).
+os.environ["SYZTPU_PALLAS_INTERPRET"] = "1"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,199 @@
+"""Pallas TPU kernels for the coverage-bitset hot paths.
+
+Two ops from ops/cover.py dominate the triage loop (reference pkg/cover:
+greedy corpus Minimize, cover.go:119-146, and the SignalNew/SignalAdd hot
+path, cover.go:104-182):
+
+- ``minimize_corpus``: a data-dependent sequential pass — program i is kept
+  iff it covers a bit not covered by the programs kept before it.  The XLA
+  version is a lax.scan whose [L]-word carry round-trips HBM every step.
+  Here the carry ("covered") lives in a VMEM scratch buffer that persists
+  across the sequential TPU grid, so each step reads one program's bits
+  from HBM and nothing else.
+
+- ``signal_stats``: fold a batch of per-program bitsets into the
+  accumulated set and count each program's new bits in the same pass —
+  one HBM read of the batch instead of XLA's separate popcount/OR sweeps.
+
+Both kernels view the [L]-word bitset as [R, 128] u32 tiles (VPU lane
+width; R padded to the 8-sublane int32 tile).  They require the full
+bitset to fit in VMEM (≤ MAX_VMEM_WORDS per buffer) — the wrappers fall
+back to the exact jnp implementations above that size or off-TPU, and
+run the same kernel in interpreter mode under tests (conftest forces
+JAX_PLATFORMS=cpu).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import ensure_x64  # noqa: F401
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+U32 = jnp.uint32
+LANES = 128
+SUBLANES = 8  # int32/uint32 min tile is (8, 128)
+
+# One bitset buffer must fit comfortably in VMEM (~16 MB/core) alongside
+# a same-sized block of program bits: cap at 4 MB = 1M words = 32 Mbit.
+MAX_VMEM_WORDS = 1 << 20
+# Per-program scalars (hit flags / new-bit counts) live in one full-array
+# SMEM block written at program_id; SMEM is small, so cap the batch.
+MAX_SMEM_ROWS = 4096
+
+
+def _tile(bits):
+    """[..., L] u32 -> [..., R, 128] with R a multiple of 8."""
+    l = bits.shape[-1]
+    r = -(-l // LANES)
+    r_pad = -(-r // SUBLANES) * SUBLANES
+    pad = r_pad * LANES - l
+    if pad:
+        bits = jnp.concatenate(
+            [bits, jnp.zeros(bits.shape[:-1] + (pad,), U32)], axis=-1)
+    return bits.reshape(bits.shape[:-1] + (r_pad, LANES)), l
+
+
+# The test suite sets SYZTPU_PALLAS_INTERPRET=1 (conftest.py) to run these
+# kernels through the pallas interpreter on its CPU backend — covering the
+# kernel logic without a chip.  Off TPU *without* that flag, production
+# dispatch falls back to the exact jnp implementations (the interpreter is
+# a per-step Python emulation, far slower than the XLA scan).
+_INTERPRET = os.environ.get("SYZTPU_PALLAS_INTERPRET", "") == "1"
+
+
+def _use_pallas(nwords: int, nrows: int) -> bool:
+    if nwords > MAX_VMEM_WORDS or nrows > MAX_SMEM_ROWS:
+        return False
+    return jax.devices()[0].platform == "tpu" or _INTERPRET
+
+
+def _minimize_kernel(bits_ref, hit_ref, covered_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        covered_ref[:] = jnp.zeros_like(covered_ref)
+
+    bits = bits_ref[0]
+    fresh = bits & ~covered_ref[:]
+    # stay strictly 32-bit signed: mosaic implements neither unsigned
+    # reductions nor jnp.any's bool path under jax_enable_x64
+    nz = jnp.sum(jax.lax.convert_element_type(fresh != U32(0), jnp.int32),
+                 dtype=jnp.int32)
+    hit = nz > 0
+    hit_ref[i] = jax.lax.convert_element_type(hit, jnp.int32)
+
+    @pl.when(hit)
+    def _():
+        covered_ref[:] = covered_ref[:] | bits
+
+
+def _minimize_pallas(tiles):
+    n, r, _ = tiles.shape
+    # the kernels are strictly 32-bit; trace them with x64 off, since the
+    # mosaic lowering rejects the weak-int64 scalars x64 mode introduces
+    with jax.enable_x64(False):
+        return pl.pallas_call(
+        _minimize_kernel,
+        grid=(n,),
+        in_specs=[pl.BlockSpec((1, r, LANES), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((n,), lambda i: (0,),
+                               memory_space=pltpu.SMEM),
+        out_shape=jax.ShapeDtypeStruct((n,), jnp.int32),
+            scratch_shapes=[pltpu.VMEM((r, LANES), U32)],
+            interpret=_INTERPRET,
+        )(tiles)
+
+
+def minimize_corpus(program_bits, sizes=None):
+    """Greedy set-cover keep-mask over per-program packed bitsets.
+
+    Drop-in for ops.cover.minimize_corpus ([N, L] u32 -> [N] bool) with
+    identical semantics; dispatches to the pallas kernel when the bitset
+    fits VMEM, else to the jnp scan."""
+    from . import cover as _cover
+
+    program_bits = jnp.asarray(program_bits, U32)
+    n, l = program_bits.shape
+    if not _use_pallas(l, n):
+        return _cover.minimize_corpus(program_bits, sizes)
+    if sizes is None:
+        sizes = jax.vmap(_cover.bitset_count)(program_bits)
+    order = jnp.argsort(-sizes)
+    tiles, _ = _tile(program_bits[order])
+    hits = _minimize_pallas(tiles)
+    return jnp.zeros(n, dtype=bool).at[order].set(hits.astype(bool))
+
+
+def _stats_kernel(acc_ref, bits_ref, count_ref, merged_ref):
+    i = pl.program_id(0)
+
+    bits = bits_ref[0]
+    fresh = bits & ~acc_ref[:]
+    pops = jax.lax.convert_element_type(
+        jax.lax.population_count(fresh), jnp.int32)
+    count_ref[i] = jnp.sum(pops, dtype=jnp.int32)
+
+    @pl.when(i == 0)
+    def _():
+        merged_ref[:] = acc_ref[:]
+
+    merged_ref[:] = merged_ref[:] | bits
+
+
+def _stats_pallas(acc_tiles, tiles):
+    n, r, _ = tiles.shape
+    with jax.enable_x64(False):
+        counts, merged = pl.pallas_call(
+        _stats_kernel,
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((r, LANES), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, r, LANES), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((n,), lambda i: (0,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((r, LANES), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n,), jnp.int32),
+            jax.ShapeDtypeStruct((r, LANES), U32),
+        ],
+            interpret=_INTERPRET,
+        )(acc_tiles, tiles)
+    return counts, merged
+
+
+def signal_stats(acc_bits, program_bits):
+    """One-pass fold + new-bit counting.
+
+    acc_bits: [L] u32 accumulated max-signal bitset.
+    program_bits: [N, L] u32 per-program signal bitsets.
+    Returns (new_counts [N] int32 — bits of each program absent from
+    acc_bits — and merged [L] u32 = acc | OR(programs))."""
+    from . import cover as _cover
+
+    acc_bits = jnp.asarray(acc_bits, U32)
+    program_bits = jnp.asarray(program_bits, U32)
+    n, l = program_bits.shape
+    if not _use_pallas(l, n):
+        fresh = program_bits & ~acc_bits[None, :]
+        counts = jax.vmap(_cover.bitset_count)(fresh).astype(jnp.int32)
+        merged = acc_bits | jax.lax.reduce(
+            program_bits, np.uint32(0), jax.lax.bitwise_or, (0,))
+        return counts, merged
+    acc_tiles, _ = _tile(acc_bits)
+    tiles, _ = _tile(program_bits)
+    counts, merged_tiles = _stats_pallas(acc_tiles, tiles)
+    return counts, merged_tiles.reshape(-1)[:l]
