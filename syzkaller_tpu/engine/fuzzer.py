@@ -51,6 +51,7 @@ class FuzzerConfig:
     log_programs: bool = False          # emit `executing program` records
     sandbox: str = "none"
     device_period: int = 16             # consume a device batch every N steps
+    mirror_bits: int = 1 << 20          # device max-signal bitset mirror
     env_config: Optional[EnvConfig] = None
     detect_supported: bool = False      # probe the live machine (pkg/host)
     leak_check: bool = False            # kmemleak scan every leak_period
@@ -131,9 +132,14 @@ class Fuzzer:
             self._leak = Kmemleak()
 
         self._device = None
+        self._max_bits = None  # device bitset mirror of max_signal
         if self.cfg.use_device:
             try:
                 self._device = _DevicePipeline(target, self.cfg)
+                import numpy as _np
+
+                self._max_bits = _np.zeros(self.cfg.mirror_bits // 32,
+                                           dtype=_np.uint32)
             except Exception:
                 self._device = None  # no jax available: host-only mode
 
@@ -196,6 +202,32 @@ class Fuzzer:
         if fresh:
             self.max_signal.update(fresh)
             self.new_signal.update(fresh)
+
+    def _fold_batch_signal(self, batch_sigs) -> None:
+        """Fold one device batch's executed signal into the device bitset
+        mirror with the fused one-pass kernel (ops/pallas_cover.py
+        signal_stats; exact-set bookkeeping already happened per-program
+        in execute()).  The per-batch new-bit count feeds the stats the
+        manager graphs."""
+        if self._max_bits is None or not batch_sigs:
+            return
+        import numpy as np
+
+        nbits = self._max_bits.shape[0] * 32
+        packed = np.zeros((len(batch_sigs), self._max_bits.shape[0]),
+                          dtype=np.uint32)
+        for i, sigs in enumerate(batch_sigs):
+            if not sigs:
+                continue
+            h = np.asarray(sigs, dtype=np.uint64) & np.uint64(nbits - 1)
+            np.bitwise_or.at(packed[i], (h >> np.uint64(5)).astype(np.int64),
+                             np.uint32(1) << (h & np.uint64(31)).astype(np.uint32))
+        from ..ops import pallas_cover
+
+        counts, merged = pallas_cover.signal_stats(self._max_bits, packed)
+        self._max_bits = np.asarray(merged, dtype=np.uint32)
+        self.stats["device_new_bits"] = self.stats.get(
+            "device_new_bits", 0) + int(np.asarray(counts).sum())
 
     # ---- execution ----
 
@@ -339,8 +371,12 @@ class Fuzzer:
             if batch:
                 self.stats["device_batches"] += 1
                 self.stats["device_candidates"] += len(batch)
+                batch_sigs = []
                 for p in batch:
-                    self.execute(p, "exec_fuzz")
+                    infos = self.execute(p, "exec_fuzz")
+                    batch_sigs.append(sorted(
+                        {s for info in infos or () for s in info.signal}))
+                self._fold_batch_signal(batch_sigs)
                 return
         item = self.queue.pop()
         if isinstance(item, TriageItem):

@@ -165,7 +165,26 @@ def signal_add(max_signal_bits, sigs):
 def minimize_corpus(program_bits, sizes=None):
     """program_bits: [N, L] u32 packed coverage per program.
     Returns keep mask [N] bool — the greedy cover: programs in decreasing
-    coverage-size order, kept iff they add an uncovered bit."""
+    coverage-size order, kept iff they add an uncovered bit.
+
+    Dispatches to the pallas kernel (ops/pallas_cover.py) on TPU when the
+    bitset fits VMEM; this function is the exact XLA-scan semantics both
+    share.  Call _minimize_corpus_xla directly from inside jit (the pallas
+    wrapper is eager)."""
+    import numpy as _np
+
+    if not isinstance(program_bits, jax.core.Tracer):
+        from . import pallas_cover
+
+        pb = jnp.asarray(program_bits, U32)
+        if pallas_cover._use_pallas(pb.shape[-1], pb.shape[0]) and \
+                jax.devices()[0].platform == "tpu":
+            return pallas_cover._minimize_pallas_entry(pb, sizes)
+    return _minimize_corpus_xla(program_bits, sizes)
+
+
+def _minimize_corpus_xla(program_bits, sizes=None):
+    """Exact XLA implementation (safe under jit; pallas fallback)."""
     program_bits = jnp.asarray(program_bits)
     n = program_bits.shape[0]
     if sizes is None:

@@ -112,24 +112,34 @@ def _minimize_pallas(tiles):
         )(tiles)
 
 
-def minimize_corpus(program_bits, sizes=None):
-    """Greedy set-cover keep-mask over per-program packed bitsets.
-
-    Drop-in for ops.cover.minimize_corpus ([N, L] u32 -> [N] bool) with
-    identical semantics; dispatches to the pallas kernel when the bitset
-    fits VMEM, else to the jnp scan."""
+def _minimize_pallas_entry(program_bits, sizes=None):
+    """Pallas-only path; caller has already checked _use_pallas."""
     from . import cover as _cover
 
     program_bits = jnp.asarray(program_bits, U32)
-    n, l = program_bits.shape
-    if not _use_pallas(l, n):
-        return _cover.minimize_corpus(program_bits, sizes)
+    n = program_bits.shape[0]
     if sizes is None:
         sizes = jax.vmap(_cover.bitset_count)(program_bits)
     order = jnp.argsort(-sizes)
     tiles, _ = _tile(program_bits[order])
     hits = _minimize_pallas(tiles)
     return jnp.zeros(n, dtype=bool).at[order].set(hits.astype(bool))
+
+
+def minimize_corpus(program_bits, sizes=None):
+    """Greedy set-cover keep-mask over per-program packed bitsets.
+
+    Drop-in for ops.cover.minimize_corpus ([N, L] u32 -> [N] bool) with
+    identical semantics; dispatches to the pallas kernel when the bitset
+    fits VMEM, else to the exact XLA scan.  ops.cover.minimize_corpus is
+    the production entry point and routes here on TPU."""
+    from . import cover as _cover
+
+    program_bits = jnp.asarray(program_bits, U32)
+    n, l = program_bits.shape
+    if not _use_pallas(l, n):
+        return _cover._minimize_corpus_xla(program_bits, sizes)
+    return _minimize_pallas_entry(program_bits, sizes)
 
 
 def _stats_kernel(acc_ref, bits_ref, count_ref, merged_ref):

@@ -75,6 +75,9 @@ class VMConfig:
     targets: List[str] = field(default_factory=list)  # user@host[:port]
     target_dir: str = "/tmp/syzkaller"
     target_reboot: bool = False
+    # odroid-specific (dev board with hard power-cycle repair)
+    console: str = ""      # host-side serial device, e.g. /dev/ttyUSB0
+    power_cycle: str = ""  # host command cycling the board's hub port
 
 
 class Instance:

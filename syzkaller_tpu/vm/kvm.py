@@ -1,0 +1,136 @@
+"""kvm VM backend: lightweight VMs via lkvm (kvmtool), no qemu.
+
+Role parity with reference /root/reference/vm/kvm/kvm.go:28-...: each
+instance is an `lkvm run` process booting the configured kernel with a
+9p-shared sandbox directory instead of a disk image.  There is no ssh
+into the guest: the guest init script polls the shared sandbox for a
+command file, executes it, and mirrors output back into the share —
+copy() just drops files into the sandbox, run() writes the command file
+and tails its output.  Console output is lkvm's stdout.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+import time
+from typing import List, Tuple
+
+from . import Instance, OutputMerger, Pool, VMConfig, register_backend
+
+# Guest-side init contract (mirrors the reference's sandbox script): poll
+# for /host/command, run it, touch /host/done when finished.
+GUEST_INIT = """#!/bin/sh
+mount -t tmpfs none /tmp
+while true; do
+  if [ -f /host/command ]; then
+    mv /host/command /host/command.running
+    sh /host/command.running > /host/output 2>&1
+    echo $? > /host/done
+  fi
+  sleep 0.1
+done
+"""
+
+
+@register_backend("kvm")
+class KvmPool(Pool):
+    @property
+    def count(self) -> int:
+        return self.cfg.count
+
+    def create(self, index: int) -> "KvmInstance":
+        return KvmInstance(self.cfg, index)
+
+
+class KvmInstance(Instance):
+    def __init__(self, cfg: VMConfig, index: int):
+        if not cfg.kernel:
+            raise ValueError("kvm backend needs a kernel image")
+        self.cfg = cfg
+        self.index = index
+        self.sandbox = os.path.join(cfg.workdir or "/tmp",
+                                    f"kvm-sandbox-{index}")
+        os.makedirs(self.sandbox, exist_ok=True)
+        init = os.path.join(self.sandbox, "init.sh")
+        with open(init, "w") as f:
+            f.write(GUEST_INIT)
+        os.chmod(init, 0o755)
+        lkvm = cfg.qemu_bin if "lkvm" in cfg.qemu_bin else "lkvm"
+        cmd = [
+            lkvm, "run",
+            "--name", f"syz-{index}",
+            "-k", cfg.kernel,
+            "-c", str(cfg.cpu),
+            "-m", str(cfg.mem_mb),
+            "--9p", f"{self.sandbox},host",
+            "--network", "mode=user",
+            # init=/host/init.sh is the command channel and must survive;
+            # qemu_args are *extra* kernel params, same meaning as in the
+            # qemu backend.
+            "--params", " ".join(["init=/host/init.sh", *cfg.qemu_args]),
+        ]
+        self.proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        self._procs: List[subprocess.Popen] = [self.proc]
+        self.merger = OutputMerger()
+        self.merger.attach(self.proc.stdout)
+        # watch the boot briefly: exit on first console output (healthy)
+        # or on early death; don't serially burn the full window per VM
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if self.proc.poll() is not None:
+                out = self.merger.output()[:4096]
+                self.close()
+                raise RuntimeError(f"lkvm exited at boot: {out!r}")
+            if self.merger.size() > 0:
+                break
+            time.sleep(0.2)
+
+    def copy(self, host_src: str) -> str:
+        dst = os.path.join(self.sandbox, os.path.basename(host_src))
+        subprocess.run(["cp", host_src, dst], check=True)
+        os.chmod(dst, 0o755)
+        return f"/host/{os.path.basename(host_src)}"
+
+    def forward(self, port: int) -> str:
+        # lkvm user-mode networking exposes the host at the gateway addr
+        # (reference kvm.go hostAddr 192.168.33.1).
+        return f"192.168.33.1:{port}"
+
+    def run(self, command: str, timeout: float
+            ) -> Tuple[OutputMerger, subprocess.Popen]:
+        for leftover in ("done", "output", "command.running"):
+            p = os.path.join(self.sandbox, leftover)
+            if os.path.exists(p):
+                os.unlink(p)
+        cmdfile = os.path.join(self.sandbox, "command")
+        with open(cmdfile + ".tmp", "w") as f:
+            f.write(command + "\n")
+        os.rename(cmdfile + ".tmp", cmdfile)
+        outpath = os.path.join(self.sandbox, "output")
+        # tail the mirrored output; terminates when done appears or on kill
+        tail = subprocess.Popen(
+            ["sh", "-c",
+             f"touch {shlex.quote(outpath)}; "
+             f"tail -f {shlex.quote(outpath)} & TP=$!; "
+             f"while [ ! -f {shlex.quote(self.sandbox)}/done ]; "
+             # grace period after done appears: let tail drain the final
+             # 9p-written chunk (a crash report's tail) before the kill
+             "do sleep 0.2; done; sleep 0.5; kill $TP"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        self._procs.append(tail)
+        # finish=False: a command's end must not mark the shared console
+        # merger (and thus the instance) dead.
+        self.merger.attach(tail.stdout, finish=False)
+        return self.merger, tail
+
+    def close(self) -> None:
+        for p in self._procs:
+            try:
+                os.killpg(os.getpgid(p.pid), 15)
+            except (ProcessLookupError, PermissionError, OSError):
+                pass

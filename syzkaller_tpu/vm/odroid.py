@@ -1,0 +1,140 @@
+"""odroid VM backend: a physical dev board with hard power-cycle repair.
+
+Role parity with reference /root/reference/vm/odroid/odroid.go:32-...:
+the board is reached over ssh (like the isolated backend), console
+output is read from a USB-serial device on the host, and when the board
+wedges it is repaired by power-cycling the USB hub port it hangs off.
+The reference drives the hub with raw libusb CLEAR_FEATURE/SET_FEATURE
+port-power requests; here the cycle shells out to a configurable command
+(`power_cycle`, e.g. ``uhubctl -l 1-1 -p 4 -a cycle``) so any hub tool
+or GPIO relay script works without C bindings.
+
+Config mapping (VMConfig): targets[0] = user@board-addr, console =
+serial device path (e.g. /dev/ttyUSB0), power_cycle = host command.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+import time
+from typing import List, Tuple
+
+from . import (
+    Instance,
+    OutputMerger,
+    Pool,
+    VMConfig,
+    _scp,
+    _ssh_args,
+    _wait_ssh,
+    register_backend,
+)
+
+
+@register_backend("odroid")
+class OdroidPool(Pool):
+    @property
+    def count(self) -> int:
+        return 1  # one physical board
+
+    def create(self, index: int) -> "OdroidInstance":
+        return OdroidInstance(self.cfg, index)
+
+
+class OdroidInstance(Instance):
+    def __init__(self, cfg: VMConfig, index: int):
+        if not cfg.targets:
+            raise ValueError("odroid backend needs targets=[user@board]")
+        self.cfg = cfg
+        self.index = index
+        self.target = cfg.targets[0]
+        self.ssh_port = 22
+        if ":" in self.target.rsplit("@", 1)[-1]:
+            self.target, port = self.target.rsplit(":", 1)
+            self.ssh_port = int(port)
+        self._procs: List[subprocess.Popen] = []
+        self.merger = OutputMerger()
+        self._console = None
+        if cfg.console:
+            # Read the board's serial console from the host side.
+            self._console = subprocess.Popen(
+                ["cat", cfg.console], stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, start_new_session=True)
+            self._procs.append(self._console)
+            self.merger.attach(self._console.stdout)
+        # never leak the console reader if the board won't come up: the
+        # caller has no Instance handle to close() yet
+        try:
+            try:
+                _wait_ssh(self.target, self.ssh_port, cfg.sshkey,
+                          f"odroid {self.target}", timeout=120.0)
+            except Exception:
+                self.repair()
+                _wait_ssh(self.target, self.ssh_port, cfg.sshkey,
+                          f"odroid {self.target}", timeout=300.0)
+            self._ssh(f"mkdir -p {shlex.quote(cfg.target_dir)}",
+                      check=False)
+        except BaseException:
+            self.close()
+            raise
+
+    def _ssh(self, command: str, check: bool = True):
+        return subprocess.run(
+            _ssh_args(self.target, self.ssh_port, self.cfg.sshkey)
+            + [command],
+            capture_output=True, timeout=120, check=check)
+
+    def repair(self) -> None:
+        """Hard power-cycle the board via the configured hub command
+        (the reference's libusb port-power dance, odroid.go ctor)."""
+        cycle = getattr(self.cfg, "power_cycle", "")
+        if not cycle:
+            raise RuntimeError(
+                "odroid board unreachable and no power_cycle configured")
+        subprocess.run(cycle, shell=True, check=True, timeout=60)
+        time.sleep(10)  # board boot latency before ssh probing resumes
+
+    def copy(self, host_src: str) -> str:
+        dst = os.path.join(self.cfg.target_dir,
+                           os.path.basename(host_src))
+        _scp(host_src, self.target, dst, self.ssh_port, self.cfg.sshkey)
+        return dst
+
+    def forward(self, port: int) -> str:
+        # reverse-forwarded at run() time like the isolated backend
+        self._fwd = getattr(self, "_fwd", [])
+        self._fwd.append(port)
+        return f"127.0.0.1:{port}"
+
+    def run(self, command: str, timeout: float
+            ) -> Tuple[OutputMerger, subprocess.Popen]:
+        fwd: List[str] = []
+        for p in getattr(self, "_fwd", []):
+            fwd += ["-R", f"{p}:127.0.0.1:{p}"]
+        proc = subprocess.Popen(
+            _ssh_args(self.target, self.ssh_port, self.cfg.sshkey)
+            + fwd + [command],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        self._procs.append(proc)
+        self.merger.attach(proc.stdout, finish=False)
+        return self.merger, proc
+
+    def close(self) -> None:
+        # the board outlives local ssh clients: reap stale fuzzer/executor
+        # trees remotely (same problem the isolated backend handles);
+        # short timeout — close() must not hang on a wedged board
+        try:
+            subprocess.run(
+                _ssh_args(self.target, self.ssh_port, self.cfg.sshkey)
+                + ["pkill -f syz- || true"],
+                capture_output=True, timeout=10, check=False)
+        except Exception:
+            pass
+        for p in self._procs:
+            try:
+                os.killpg(os.getpgid(p.pid), 15)
+            except (ProcessLookupError, PermissionError, OSError):
+                pass

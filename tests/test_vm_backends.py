@@ -1,0 +1,125 @@
+"""kvm (lkvm) and odroid backend tests — hermetic: lkvm is faked with a
+shell script, ssh probes are stubbed, the serial console is a FIFO-less
+plain file.  Mirrors the registration/argv checks the isolated/adb
+backends get in test_vmloop.py."""
+
+import os
+import stat
+import time
+
+import pytest
+
+import syzkaller_tpu.vm as vm_mod
+from syzkaller_tpu.vm import VMConfig, create
+
+
+FAKE_LKVM = """#!/bin/sh
+# fake lkvm: prints its argv, then idles like a booted VM
+echo "fake-lkvm $@"
+exec sleep 300
+"""
+
+
+@pytest.fixture
+def fake_lkvm(tmp_path):
+    p = tmp_path / "lkvm"
+    p.write_text(FAKE_LKVM)
+    p.chmod(p.stat().st_mode | stat.S_IEXEC)
+    return str(p)
+
+
+def test_kvm_backend_boot_run_close(tmp_path, fake_lkvm):
+    kernel = tmp_path / "bzImage"
+    kernel.write_bytes(b"\x00")
+    cfg = VMConfig(type="kvm", count=2, workdir=str(tmp_path),
+                   kernel=str(kernel), qemu_bin=fake_lkvm,
+                   cpu=1, mem_mb=128)
+    pool = create(cfg)
+    assert pool.count == 2
+    inst = pool.create(0)
+    try:
+        # sandbox prepared with the guest init contract
+        sandbox = os.path.join(str(tmp_path), "kvm-sandbox-0")
+        assert os.path.exists(os.path.join(sandbox, "init.sh"))
+        # console shows the lkvm invocation with the 9p share
+        deadline = time.time() + 10
+        while time.time() < deadline and b"--9p" not in inst.merger.output():
+            time.sleep(0.1)
+        out = inst.merger.output()
+        assert b"fake-lkvm" in out and b"--9p" in out
+        # copy drops into the sandbox, guest path under /host
+        src = tmp_path / "payload"
+        src.write_text("hi")
+        gpath = inst.copy(str(src))
+        assert gpath == "/host/payload"
+        assert (tmp_path / "kvm-sandbox-0" / "payload").exists()
+        # manager address rides lkvm's user-network gateway
+        assert inst.forward(7788) == "192.168.33.1:7788"
+        # run(): command file appears; simulate guest completing it
+        merger, handle = inst.run("echo done-marker", timeout=5)
+        cmdfile = os.path.join(sandbox, "command")
+        deadline = time.time() + 5
+        while time.time() < deadline and not os.path.exists(cmdfile):
+            time.sleep(0.05)
+        assert os.path.exists(cmdfile)
+        with open(os.path.join(sandbox, "output"), "w") as f:
+            f.write("guest-output-line\n")
+        with open(os.path.join(sandbox, "done"), "w") as f:
+            f.write("0\n")
+        deadline = time.time() + 10
+        while time.time() < deadline and handle.poll() is None:
+            time.sleep(0.1)
+        assert handle.poll() is not None
+    finally:
+        inst.close()
+
+
+def test_kvm_backend_boot_failure(tmp_path):
+    bad = tmp_path / "lkvm"
+    bad.write_text("#!/bin/sh\necho broken; exit 1\n")
+    bad.chmod(0o755)
+    kernel = tmp_path / "bzImage"
+    kernel.write_bytes(b"\x00")
+    cfg = VMConfig(type="kvm", count=1, workdir=str(tmp_path),
+                   kernel=str(kernel), qemu_bin=str(bad))
+    pool = create(cfg)
+    with pytest.raises(RuntimeError, match="lkvm exited"):
+        pool.create(0)
+
+
+def test_odroid_backend(tmp_path, monkeypatch):
+    from syzkaller_tpu.vm.odroid import OdroidInstance
+
+    console = tmp_path / "ttyUSB0"
+    console.write_text("board console line\n")
+    monkeypatch.setattr(vm_mod, "_wait_ssh",
+                        lambda *a, **k: None)
+    import syzkaller_tpu.vm.odroid as od
+    monkeypatch.setattr(od, "_wait_ssh", lambda *a, **k: None)
+    monkeypatch.setattr(OdroidInstance, "_ssh",
+                        lambda self, cmd, check=True: None)
+    cfg = VMConfig(type="odroid", targets=["root@10.0.0.7"],
+                   console=str(console),
+                   power_cycle="true")
+    pool = create(cfg)
+    assert pool.count == 1
+    inst = pool.create(0)
+    try:
+        assert (inst.target, inst.ssh_port) == ("root@10.0.0.7", 22)
+        # console stream lands in the merger
+        deadline = time.time() + 5
+        while time.time() < deadline and \
+                b"board console" not in inst.merger.output():
+            time.sleep(0.05)
+        assert b"board console line" in inst.merger.output()
+        # repair shells out to the configured power-cycle command
+        monkeypatch.setattr(od.time, "sleep", lambda s: None)
+        inst.repair()  # "true" exits 0; would raise on failure
+        cfg_nocycle = VMConfig(type="odroid", targets=["root@x"],
+                               power_cycle="")
+        inst2 = OdroidInstance.__new__(OdroidInstance)
+        inst2.cfg = cfg_nocycle
+        with pytest.raises(RuntimeError, match="power_cycle"):
+            inst2.repair()
+    finally:
+        inst.close()
