@@ -138,8 +138,10 @@ class Fuzzer:
                 self._device = _DevicePipeline(target, self.cfg)
                 import numpy as _np
 
-                self._max_bits = _np.zeros(self.cfg.mirror_bits // 32,
-                                           dtype=_np.uint32)
+                # the mirror indexes by low hash bits: must be a power of
+                # two or the (nbits-1) mask zeroes arbitrary positions
+                nbits = 1 << (self.cfg.mirror_bits - 1).bit_length()
+                self._max_bits = _np.zeros(nbits // 32, dtype=_np.uint32)
             except Exception:
                 self._device = None  # no jax available: host-only mode
 

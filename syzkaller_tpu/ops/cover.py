@@ -171,14 +171,11 @@ def minimize_corpus(program_bits, sizes=None):
     bitset fits VMEM; this function is the exact XLA-scan semantics both
     share.  Call _minimize_corpus_xla directly from inside jit (the pallas
     wrapper is eager)."""
-    import numpy as _np
-
     if not isinstance(program_bits, jax.core.Tracer):
         from . import pallas_cover
 
         pb = jnp.asarray(program_bits, U32)
-        if pallas_cover._use_pallas(pb.shape[-1], pb.shape[0]) and \
-                jax.devices()[0].platform == "tpu":
+        if pallas_cover._use_pallas(pb.shape[-1], pb.shape[0]):
             return pallas_cover._minimize_pallas_entry(pb, sizes)
     return _minimize_corpus_xla(program_bits, sizes)
 

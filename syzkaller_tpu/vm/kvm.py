@@ -53,11 +53,21 @@ class KvmInstance(Instance):
         self.sandbox = os.path.join(cfg.workdir or "/tmp",
                                     f"kvm-sandbox-{index}")
         os.makedirs(self.sandbox, exist_ok=True)
+        # the sandbox path is reused across instance recreations: drop any
+        # stale control files or the fresh guest executes last session's
+        # command before the new package is even copied in
+        for stale in ("command", "command.running", "done", "output"):
+            p = os.path.join(self.sandbox, stale)
+            if os.path.exists(p):
+                os.unlink(p)
         init = os.path.join(self.sandbox, "init.sh")
         with open(init, "w") as f:
             f.write(GUEST_INIT)
         os.chmod(init, 0o755)
-        lkvm = cfg.qemu_bin if "lkvm" in cfg.qemu_bin else "lkvm"
+        # qemu_bin doubles as the lkvm path here; its qemu-specific default
+        # obviously isn't kvmtool, so only a non-default value is honored
+        lkvm = cfg.qemu_bin if cfg.qemu_bin not in (
+            "", "qemu-system-x86_64") else "lkvm"
         cmd = [
             lkvm, "run",
             "--name", f"syz-{index}",
@@ -90,8 +100,10 @@ class KvmInstance(Instance):
             time.sleep(0.2)
 
     def copy(self, host_src: str) -> str:
+        import shutil
+
         dst = os.path.join(self.sandbox, os.path.basename(host_src))
-        subprocess.run(["cp", host_src, dst], check=True)
+        shutil.copy(host_src, dst)
         os.chmod(dst, 0o755)
         return f"/host/{os.path.basename(host_src)}"
 
@@ -118,8 +130,11 @@ class KvmInstance(Instance):
              f"tail -f {shlex.quote(outpath)} & TP=$!; "
              f"while [ ! -f {shlex.quote(self.sandbox)}/done ]; "
              # grace period after done appears: let tail drain the final
-             # 9p-written chunk (a crash report's tail) before the kill
-             "do sleep 0.2; done; sleep 0.5; kill $TP"],
+             # 9p-written chunk (a crash report's tail) before the kill;
+             # then propagate the guest command's exit status so the
+             # monitor's lost-connection detection works like ssh's
+             "do sleep 0.2; done; sleep 0.5; kill $TP; "
+             f"exit $(cat {shlex.quote(self.sandbox)}/done)"],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             start_new_session=True)
         self._procs.append(tail)
