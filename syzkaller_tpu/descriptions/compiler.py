@@ -122,6 +122,7 @@ class Compiler:
             resources.append(self._resource_desc(name))
 
         syscalls: List[Syscall] = []
+        seen_calls: Dict[str, str] = {}
         dyn_pseudo = sorted({cd.call_name for cd in self.calls
                              if cd.call_name.startswith("syz_")
                              and cd.call_name not in PSEUDO_IDS})
@@ -149,6 +150,14 @@ class Compiler:
                 if nr is None:
                     self.unsupported.append(f"{cd.name}: no __NR_{cd.call_name}")
                     continue
+            if cd.name in seen_calls:
+                # duplicate full names (same base$variant) make text
+                # deserialization ambiguous; the reference's compiler
+                # rejects them too (pkg/compiler check.go)
+                raise CompileError(
+                    f"{cd.pos}: duplicate syscall {cd.name!r} "
+                    f"(first declared at {seen_calls[cd.name]})")
+            seen_calls[cd.name] = str(cd.pos)
             syscalls.append(Syscall(
                 id=len(syscalls), nr=nr, name=cd.name,
                 call_name=cd.call_name, args=args, ret=ret))

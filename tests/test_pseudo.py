@@ -107,11 +107,17 @@ def test_executor_kvm_chain(target, tmp_path):
     import errno as e
 
     if os.path.exists("/dev/kvm") and os.access("/dev/kvm", os.W_OK):
-        assert [i.errno for i in infos] == [0, 0, 0, 0]
+        assert infos[0].errno == 0
+        # some sandboxes expose a /dev/kvm node whose ioctls are stubbed
+        # out (ENOTTY/ENODEV/EPERM): the open works, virtualization
+        # doesn't — only a working CREATE_VM obliges the full chain
+        assert infos[1].errno in (0, e.ENOTTY, e.ENODEV, e.EPERM)
+        if infos[1].errno == 0:
+            assert [i.errno for i in infos] == [0, 0, 0, 0]
     else:
         assert infos[0].errno in (e.ENOENT, e.EACCES, e.EPERM)
-        # downstream calls see invalid fds, not a broken dispatcher
-        assert all(i.errno != e.ENOSYS for i in infos)
+    # downstream calls see invalid fds, not a broken dispatcher
+    assert all(i.errno != e.ENOSYS for i in infos)
 
 
 def test_kmemleak_parse():
