@@ -78,6 +78,8 @@ class VMConfig:
     # odroid-specific (dev board with hard power-cycle repair)
     console: str = ""      # host-side serial device, e.g. /dev/ttyUSB0
     power_cycle: str = ""  # host command cycling the board's hub port
+    # kvm-specific (lkvm/kvmtool)
+    lkvm_bin: str = "lkvm"
 
 
 class Instance:

@@ -64,14 +64,8 @@ class KvmInstance(Instance):
         with open(init, "w") as f:
             f.write(GUEST_INIT)
         os.chmod(init, 0o755)
-        # qemu_bin doubles as the lkvm path here; any qemu-system-* value
-        # (the field's default or a full qemu path carried over from a
-        # qemu config) obviously isn't kvmtool, so fall back to lkvm
-        base = os.path.basename(cfg.qemu_bin)
-        lkvm = cfg.qemu_bin if cfg.qemu_bin and \
-            not base.startswith("qemu-system") else "lkvm"
         cmd = [
-            lkvm, "run",
+            cfg.lkvm_bin, "run",
             "--name", f"syz-{index}",
             "-k", cfg.kernel,
             "-c", str(cfg.cpu),

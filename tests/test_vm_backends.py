@@ -32,7 +32,7 @@ def test_kvm_backend_boot_run_close(tmp_path, fake_lkvm):
     kernel = tmp_path / "bzImage"
     kernel.write_bytes(b"\x00")
     cfg = VMConfig(type="kvm", count=2, workdir=str(tmp_path),
-                   kernel=str(kernel), qemu_bin=fake_lkvm,
+                   kernel=str(kernel), lkvm_bin=fake_lkvm,
                    cpu=1, mem_mb=128)
     pool = create(cfg)
     assert pool.count == 2
@@ -81,7 +81,7 @@ def test_kvm_backend_boot_failure(tmp_path):
     kernel = tmp_path / "bzImage"
     kernel.write_bytes(b"\x00")
     cfg = VMConfig(type="kvm", count=1, workdir=str(tmp_path),
-                   kernel=str(kernel), qemu_bin=str(bad))
+                   kernel=str(kernel), lkvm_bin=str(bad))
     pool = create(cfg)
     with pytest.raises(RuntimeError, match="lkvm exited"):
         pool.create(0)
