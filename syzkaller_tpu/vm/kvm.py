@@ -48,6 +48,12 @@ class KvmInstance(Instance):
     def __init__(self, cfg: VMConfig, index: int):
         if not cfg.kernel:
             raise ValueError("kvm backend needs a kernel image")
+        if cfg.qemu_bin not in ("", "qemu-system-x86_64") and \
+                cfg.lkvm_bin == "lkvm":
+            # old configs pointed qemu_bin at the kvmtool binary; fail
+            # loudly instead of silently execing bare "lkvm" from PATH
+            raise ValueError(
+                "kvm backend: set lkvm_bin (qemu_bin is ignored here)")
         self.cfg = cfg
         self.index = index
         self.sandbox = os.path.join(cfg.workdir or "/tmp",
