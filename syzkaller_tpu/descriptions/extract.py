@@ -246,6 +246,11 @@ def derive_arm64(base: Dict[str, int]) -> Dict[str, int]:
     asm-generic definitions.  Legacy calls with no arm64 trap (open, pipe,
     dup2, rename, poll, ...) get no __NR_* entry and stay unsupported at
     compile time, matching real arm64 kernels.
+
+    Non-__NR_ consts are copied wholesale, so x86-only values (ARCH_SET_GS
+    and friends) ride along inert: the compiler only reads consts that a
+    supported call's types reference, and x86-only calls are already
+    excluded by their missing __NR_* entry.
     """
     out = {k: v for k, v in base.items() if not k.startswith("__NR_")}
 
