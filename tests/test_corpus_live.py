@@ -1,0 +1,78 @@
+"""Whole-corpus liveness check against the real kernel.
+
+Every linux/amd64 syscall variant is executed once through the real C++
+executor; a call answered with ENOSYS means its syscall number is wrong
+(bad __NR_* const, broken pseudo-call dispatch) — precisely the class of
+corpus bug nothing else catches, since generation/serialization tests
+never reach the kernel.  Any other errno (EBADF/EINVAL/EPERM/...) is a
+legitimate answer for type-correct-but-unresourced arguments.
+
+Slow-ish (one pass over ~900 variants, batched); marked for the tail of
+the suite via its filename ordering.
+"""
+
+import errno
+import os
+
+import pytest
+
+from syzkaller_tpu.ipc import Env, ExecOpts
+from syzkaller_tpu.prog import get_target
+from syzkaller_tpu.prog.generation import RandGen
+from syzkaller_tpu.prog.analysis import analyze
+from syzkaller_tpu.prog.prog import Prog
+
+# Calls that legitimately block (the parent kills the child on timeout and
+# the batch's remaining calls go unexecuted) or that reconfigure the host
+# (VT switching) are exercised elsewhere; skip them here so the sweep
+# stays fast and self-contained.
+SKIP = {
+    "pause", "waitid", "wait4", "rt_sigtimedwait", "epoll_pwait",
+    "epoll_wait", "ppoll", "pselect6", "select", "poll", "read", "readv",
+    "recvfrom", "recvmsg", "accept", "accept4", "msgrcv", "semop",
+    "semtimedop", "flock", "fcntl", "ioctl$VT_WAITACTIVE",
+    "ioctl$VT_ACTIVATE", "ioctl$NBD_DO_IT", "io_getevents", "syz_mmap",
+    "ioctl$KDMKTONE", "ioctl$KIOCSOUND", "ioctl$TIOCSTI",
+}
+
+
+def test_every_variant_reaches_the_kernel(tmp_path):
+    target = get_target("linux", "amd64")
+    rng = RandGen(target, seed=1234)
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    enosys = []
+    executed = 0
+    try:
+        with Env(target, pid=0) as env:
+            batch = []
+            metas = [m for m in target.syscalls
+                     if m.name not in SKIP and m.call_name not in SKIP]
+            for i, meta in enumerate(metas):
+                batch.append(meta)
+                if len(batch) < 8 and i != len(metas) - 1:
+                    continue
+                p = Prog(target)
+                s = analyze(None, p, None)
+                names = []
+                for m in batch:
+                    for c in rng.generate_particular_call(s, m):
+                        p.calls.append(c)
+                        names.append(c.meta.name)
+                batch = []
+                opts = ExecOpts(timeout_ms=3000)
+                _, infos, failed, hanged = env.exec(opts, p)
+                if failed or hanged:
+                    continue  # a mid-batch blocking call; NRs still fine
+                for c, info in zip(p.calls, infos):
+                    executed += 1
+                    if info.errno == errno.ENOSYS and \
+                            not c.meta.call_name.startswith("syz_"):
+                        enosys.append(c.meta.name)
+    finally:
+        os.chdir(cwd)
+    # A handful of surfaces may genuinely be compiled out of this test
+    # kernel; wrong NRs would show up as a broad scatter, so bound the
+    # count rather than requiring zero.
+    assert executed > 400, f"too few calls executed ({executed})"
+    assert len(enosys) <= 12, f"ENOSYS from: {sorted(set(enosys))}"
