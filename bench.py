@@ -45,11 +45,20 @@ def bench_device(dt, B=16384, C=16, iters=20):
             one, (key, cid, sval, data), None, length=iters)
         return cid, sval, data
 
+    import numpy as np
+
+    def sync(arrs):
+        # block_until_ready on the axon tunnel intermittently returns
+        # before the computation lands (experimental plugin); a tiny
+        # device->host transfer is an unconditional barrier
+        jax.block_until_ready(arrs)
+        np.asarray(arrs[0][:1])
+
     cid, sval, data = dmut.generate_batch(key, dt, B=B, C=C)
-    jax.block_until_ready(cid)
+    sync((cid,))
     # warmup dispatch compiles the chain
     out = chain(key, cid, sval, data)
-    jax.block_until_ready(out)
+    sync(out)
 
     # best-of-3: the axon tunnel adds occasional multi-second stalls that
     # would otherwise make single-shot numbers flap by ~10x
@@ -57,7 +66,7 @@ def bench_device(dt, B=16384, C=16, iters=20):
     for rep in range(3):
         t0 = time.perf_counter()
         out = chain(jax.random.fold_in(key, rep + 1), *out)
-        jax.block_until_ready(out)
+        sync(out)
         dt_s = time.perf_counter() - t0
         best = max(best, B * iters / dt_s)
     return best
