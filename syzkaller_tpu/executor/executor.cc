@@ -612,9 +612,15 @@ static uint64 execute_pseudo(uint64 nr, uint64* args, int* err) {
   return (uint64)-1;
 }
 
+// pid of the process executing the program: a program call that forks
+// (clone/clone3/fork in the corpus) must not let the child continue the
+// program loop, or two processes race writing output records.
+static pid_t program_pid;
+
 static void execute_call(thread_t* th) {
   if (flag_kcov) kcov_reset(&th->cov);
   bool faulted = fault_injection_enter(th);
+  long tid_before = syscall(SYS_gettid);
   errno = 0;
   uint64 ret;
   int err = 0;
@@ -625,6 +631,12 @@ static void execute_call(thread_t* th) {
                           th->args[3], th->args[4], th->args[5]);
     err = (ret == (uint64)-1) ? errno : 0;
   }
+  // A forked child process resumes here too; so does a raw
+  // clone3(CLONE_THREAD, stack=0) thread (same pid, new tid, parent's
+  // sp). Neither may continue the program loop or they race the real
+  // thread on syscalls and output records.
+  if (program_pid && getpid() != program_pid) _exit(0);
+  if (syscall(SYS_gettid) != tid_before) syscall(SYS_exit, 0);
   th->ret = ret;
   th->err = err;
   th->executed = true;
@@ -888,6 +900,7 @@ static uint64 read_arg(parser_t* p, uint64 copyin_addr) {
 }
 
 static void execute_one() {
+  program_pid = getpid();
   memset(results, 0, sizeof(results));
   out_reset();
 

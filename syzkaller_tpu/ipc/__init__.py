@@ -248,13 +248,24 @@ class Env:
         elif status == P.STATUS_HANGED:
             hanged = True
         infos = self._parse_out()
+        # A program call that forks (clone/clone3) can race a child into
+        # the record stream before the executor's post-call pid check
+        # reaps it: drop records for out-of-range indexes and keep only
+        # the first record per call.
+        seen: set = set()
+        deduped = []
+        for info in infos:
+            if info.index >= len(p.calls) or info.index in seen:
+                continue
+            seen.add(info.index)
+            deduped.append(info)
+        infos = deduped
         # Pad calls with no record (child died mid-program: seccomp strict,
         # exit(), hang kill) as not-executed, errno=-1 — one info per call,
         # like the reference's ipc (reference pkg/ipc/ipc_linux.go fills
         # len(p.Calls) infos and leaves unexecuted ones marked).
-        have = {i.index for i in infos}
         for idx, call in enumerate(p.calls):
-            if idx not in have:
+            if idx not in seen:
                 infos.append(CallInfo(
                     index=idx, num=call.meta.id, errno=-1,
                     executed=False, fault_injected=False,
