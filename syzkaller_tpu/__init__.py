@@ -19,7 +19,7 @@ Layout:
 
 # NOTE: importing the top-level package stays jax-free so the description
 # pipeline and program IR work standalone; the device modules
-# (ops/, parallel/, engine/, models/) call utils.jaxcfg.ensure_x64() which
+# (ops/, parallel/, engine/) call utils.jaxcfg.ensure_x64() which
 # enables 64-bit lanes (program words and signal hashes are u64/u32).
 
 __version__ = "0.1.0"

@@ -361,6 +361,12 @@ class Compiler:
                 # network-order by definition, so require intNbe.
                 raise CompileError(
                     f"{te.pos}: csum base type must be big-endian (int16be)")
+            if base.size != 2:
+                # The executor writes the 16-bit checksum at bytes 0-1 of
+                # the field; a wider field would hold it in the wrong
+                # (most-significant) bytes, silently shifting the value.
+                raise CompileError(
+                    f"{te.pos}: csum base type must be 2 bytes (int16be)")
             return CsumType(name="csum", field_name=fname, size=base.size,
                             dir=dir, big_endian=base.big_endian, kind=kind,
                             buf=args[0].name, protocol=protocol)

@@ -872,6 +872,10 @@ static uint64 read_arg(parser_t* p, uint64 copyin_addr) {
         uint64 value = p->next();
         uint64 chunk_size = p->next();
         if (chunk_kind == kCsumChunkConst) {
+          // 4-byte consts (IPv6 pseudo-header length/next-header words)
+          // sum as two big-endian 16-bit words; 2-byte consts as one.
+          if (chunk_size == 4)
+            acc += (uint32)((value >> 16) & 0xffff);
           acc += (uint32)(value & 0xffff);
         } else {
           NONFAILING({

@@ -250,22 +250,23 @@ class Env:
         infos = self._parse_out()
         # A program call that forks (clone/clone3) can race a child into
         # the record stream before the executor's post-call pid check
-        # reaps it: drop records for out-of-range indexes and keep only
-        # the first record per call.
-        seen: set = set()
-        deduped = []
+        # reaps it: drop records for out-of-range indexes and keep one
+        # record per call, preferring an executed record over a raced
+        # non-executed one regardless of arrival order.
+        by_index: dict = {}
         for info in infos:
-            if info.index >= len(p.calls) or info.index in seen:
+            if info.index >= len(p.calls):
                 continue
-            seen.add(info.index)
-            deduped.append(info)
-        infos = deduped
+            prev = by_index.get(info.index)
+            if prev is None or (info.executed and not prev.executed):
+                by_index[info.index] = info
+        infos = list(by_index.values())
         # Pad calls with no record (child died mid-program: seccomp strict,
         # exit(), hang kill) as not-executed, errno=-1 — one info per call,
         # like the reference's ipc (reference pkg/ipc/ipc_linux.go fills
         # len(p.Calls) infos and leaves unexecuted ones marked).
         for idx, call in enumerate(p.calls):
-            if idx not in seen:
+            if idx not in by_index:
                 infos.append(CallInfo(
                     index=idx, num=call.meta.id, errno=-1,
                     executed=False, fault_injected=False,

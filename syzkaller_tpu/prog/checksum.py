@@ -126,8 +126,14 @@ def calc_checksums(pointee: Arg) -> List[CsumInstr]:
                 continue
             chunks.append(Chunk(CHUNK_DATA, src[1], src[0].size()))
             chunks.append(Chunk(CHUNK_DATA, dst[1], dst[0].size()))
-            chunks.append(Chunk(CHUNK_CONST, typ.protocol, 2))
-            chunks.append(Chunk(CHUNK_CONST, buf_arg.size(), 2))
+            # IPv6 pseudo headers (16-byte addresses) carry 32-bit
+            # upper-layer length and next-header words; IPv4's are 16-bit
+            # (reference prog/checksum.go composePseudoCsumIPv4/IPv6).
+            # The 4-byte form also keeps payloads >= 64KiB from silently
+            # truncating the length term.
+            cw = 4 if src[0].size() == 16 else 2
+            chunks.append(Chunk(CHUNK_CONST, typ.protocol, cw))
+            chunks.append(Chunk(CHUNK_CONST, buf_arg.size(), cw))
         chunks.append(Chunk(CHUNK_DATA, buf_off, buf_arg.size()))
         out.append(CsumInstr(offset=off, size=arg.size(), chunks=chunks))
     return out

@@ -116,6 +116,16 @@ class KvmInstance(Instance):
 
     def run(self, command: str, timeout: float
             ) -> Tuple[OutputMerger, subprocess.Popen]:
+        # One in-flight guest command per instance: the 9p control files
+        # (command/output/done) are shared state, so a second run() while
+        # the previous tail is still alive would interleave output and
+        # exit status.  Reap a finished tail; refuse while one is running.
+        prev = getattr(self, "_tail", None)
+        if prev is not None:
+            if prev.poll() is None:
+                raise RuntimeError(
+                    "kvm instance busy: previous run() still in flight")
+            self._tail = None
         for leftover in ("done", "output", "command.running"):
             p = os.path.join(self.sandbox, leftover)
             if os.path.exists(p):
@@ -140,6 +150,7 @@ class KvmInstance(Instance):
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             start_new_session=True)
         self._procs.append(tail)
+        self._tail = tail
         # finish=False: a command's end must not mark the shared console
         # merger (and thus the instance) dead.
         self.merger.attach(tail.stdout, finish=False)

@@ -61,6 +61,22 @@ def test_ipv4_header_csum_instruction():
     assert proto == 6  # IPPROTO_TCP
 
 
+def test_ipv6_pseudo_uses_4byte_consts():
+    # IPv6 pseudo headers carry 32-bit length/next-header words
+    # (reference prog/checksum.go composePseudoCsumIPv6) — the 2-byte form
+    # would silently truncate payloads >= 64KiB.
+    p = _emit_prog("syz_emit_ethernet$ipv6_tcp")
+    instrs = decode_exec(serialize_for_exec(p, 0))
+    csums = [i for i in instrs
+             if i["op"] == "copyin" and i["arg"]["kind"] == "csum"]
+    pseudo = [c for c in csums if len(c["arg"]["chunks"]) == 5]
+    assert len(pseudo) == 1
+    consts = [ch for ch in pseudo[0]["arg"]["chunks"]
+              if ch["kind"] == CHUNK_CONST]
+    assert [ch["size"] for ch in consts] == [4, 4]
+    assert consts[0]["value"] == 6  # IPPROTO_TCP
+
+
 def test_udp_pseudo_proto():
     p = _emit_prog("syz_emit_ethernet$ipv4_udp")
     instrs = decode_exec(serialize_for_exec(p, 0))
