@@ -84,6 +84,65 @@ def _init_arch(target: Target) -> None:
         target.analyze_mmap = analyze_mmap
     target.sanitize_call = sanitize_call
     target.string_dictionary = list(STRING_DICTIONARY)
+    _register_special_structs(target)
+
+
+def _register_special_structs(target: Target) -> None:
+    """timespec/timeval generators (reference sys/linux/init.go:214-280):
+    random struct bytes would make every timeout-taking call block forever
+    or return instantly at random, so generate values that are (1) now/past,
+    (2) a few ms ahead (straddling the executor's 20ms call timeout: both
+    10ms and 30ms), (3) unreachable future, or (4) absolute few-ms-ahead by
+    chaining a clock_gettime(CLOCK_REALTIME) call and adding the delta via
+    the exec-format result ops (op_div/op_add)."""
+    cg = target.syscall_map.get("clock_gettime")
+    clock_realtime = target.consts.get("CLOCK_REALTIME", 0)
+
+    def gen_time(r, s, typ, old):
+        usec = typ.name == "timeval"
+        f0, f1 = typ.fields[0], typ.fields[1]
+        calls: list = []
+        if r.n_out_of(1, 4):
+            # Now for relative, past for absolute.
+            inner = [progmod.make_result_arg(f0, None, 0),
+                     progmod.make_result_arg(f1, None, 0)]
+        elif r.n_out_of(1, 3):
+            # Few ms ahead for relative, past for absolute.
+            nsec = 10_000_000 if r.n_out_of(1, 2) else 30_000_000
+            if usec:
+                nsec //= 1000
+            inner = [progmod.make_result_arg(f0, None, 0),
+                     progmod.make_result_arg(f1, None, nsec)]
+        elif r.n_out_of(1, 2) or cg is None:
+            # Unreachable future for both relative and absolute.
+            inner = [progmod.make_result_arg(f0, None, 2 * 10**9),
+                     progmod.make_result_arg(f1, None, 0)]
+        else:
+            # Few ms ahead for absolute: clock_gettime(REALTIME, &tp),
+            # then sec=tp.sec, nsec=tp.nsec/op_div+op_add.
+            ptr_t = cg.args[1]
+            ts_t = ptr_t.elem
+            tp_inner = [progmod.make_result_arg(ts_t.fields[0], None, 0),
+                        progmod.make_result_arg(ts_t.fields[1], None, 0)]
+            tp = progmod.GroupArg(ts_t, tp_inner)
+            tpaddr, calls = r.alloc(s, ptr_t, tp.size(), tp)
+            calls = list(calls) + [progmod.Call(
+                meta=cg,
+                args=[progmod.ConstArg(cg.args[0], clock_realtime), tpaddr],
+                ret=progmod.ReturnArg(cg.ret))]
+            msec = 10 if r.n_out_of(1, 2) else 30
+            sec = progmod.make_result_arg(f0, tp_inner[0], 0)
+            if usec:
+                nsec = progmod.ResultArg(f1, res=tp_inner[1],
+                                         op_div=1000, op_add=msec * 1000)
+            else:
+                nsec = progmod.ResultArg(f1, res=tp_inner[1],
+                                         op_add=msec * 1_000_000)
+            tp_inner[1].uses.add(nsec)
+            inner = [sec, nsec]
+        return progmod.GroupArg(typ, inner), calls
+
+    target.special_structs = {"timespec": gen_time, "timeval": gen_time}
 
 
 def ensure_registered(arch: str = "amd64") -> Target:

@@ -256,6 +256,13 @@ class RandGen:
                 return PointerArg(typ, i, 0, 0, data), [c]
         return self.rand_page_addr(s, typ, npages, data, False), []
 
+    def alloc(self, s: State, typ, size: int, data: Optional[Arg]):
+        """Guaranteed-valid allocation (reference prog.Gen.Alloc): for
+        buffers the program itself must read back (e.g. clock_gettime
+        output feeding a timespec), never the deliberately-corrupted
+        offsets addr() mixes in."""
+        return self._addr1(s, typ, size, data)
+
     def addr(self, s: State, typ, size: int, data: Optional[Arg]):
         arg, calls = self._addr1(s, typ, size, data)
         if self.n_out_of(50, 102):

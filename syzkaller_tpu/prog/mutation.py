@@ -330,9 +330,11 @@ def _mutate_arg(r: RandGen, s: State, p: Prog, c: Call, arg: Arg) -> None:
         if gen is None:
             raise TypeError("mutation_args returned a plain struct")
         arg1, calls1 = gen(r, s, t, arg)
-        for i, f in enumerate(arg1.inner):
-            p.replace_arg(c, arg.inner[i], f, calls1)
-            calls1 = []
+        # Whole-struct replacement: after a serialize round-trip the old
+        # fields are ConstArgs while the generator emits ResultArgs, so a
+        # field-by-field replace would drop the res links (and leave the
+        # chained clock_gettime dead).
+        p.replace_arg(c, arg, arg1, calls1)
     elif isinstance(t, UnionType):
         options = [f for f in t.fields
                    if f.field_name != arg.option_type.field_name]

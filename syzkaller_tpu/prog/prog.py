@@ -337,6 +337,15 @@ class Prog:
         elif isinstance(arg, UnionArg):
             arg.option = arg1.option
             arg.option_type = arg1.option_type
+        elif isinstance(arg, GroupArg):
+            # Wholesale field replacement (special-struct regeneration):
+            # field classes may differ between old and new (a deserialized
+            # struct has ConstArg fields, the generator emits ResultArgs),
+            # so sever the old subtree's dataflow and adopt the new fields.
+            for f in arg.inner:
+                self.remove_arg(c, f)
+            arg.inner = arg1.inner
+            arg.typ = arg1.typ
         elif isinstance(arg, DataArg):
             arg.data = arg1.data
         else:

@@ -1,0 +1,165 @@
+"""special_structs generators (reference sys/linux/init.go:12-60,214-280):
+timespec/timeval must come out of the arch generator — zero/small-delta/
+far-future values or a chained clock_gettime — never random struct bytes.
+"""
+
+from syzkaller_tpu.prog import get_target
+from syzkaller_tpu.prog.encoding import deserialize, serialize
+from syzkaller_tpu.prog.generation import RandGen, generate
+from syzkaller_tpu.prog.mutation import mutate
+from syzkaller_tpu.prog.types import Dir
+from syzkaller_tpu.prog.prog import (
+    GroupArg,
+    ResultArg,
+    foreach_arg,
+    foreach_subarg,
+)
+
+
+def target():
+    return get_target("linux", "amd64")
+
+
+def test_special_structs_registered():
+    t = target()
+    assert set(t.special_structs) == {"timespec", "timeval"}
+
+
+def _collect_time_structs(p):
+    found = []
+    for c in p.calls:
+        def visit(a, _b=None):
+            if isinstance(a, GroupArg) and \
+                    getattr(a.typ, "name", None) in ("timespec", "timeval"):
+                found.append((c, a))
+        foreach_arg(c, lambda a, b: foreach_subarg(a, visit))
+    return found
+
+
+def test_generator_fires_for_nanosleep():
+    t = target()
+    meta = t.syscall_map["nanosleep"]
+    saw_gettime = saw_small = 0
+    for seed in range(60):
+        from syzkaller_tpu.prog.analysis import analyze
+        from syzkaller_tpu.prog.prog import Prog
+
+        r = RandGen(t, seed=seed)
+        p = Prog(t)
+        s = analyze(None, p, None)
+        for c in r.generate_particular_call(s, meta):
+            p.calls.append(c)
+        p.validate()
+        structs = _collect_time_structs(p)
+        # nanosleep(req ptr[in, timespec], rem ptr[out, ...]): the IN one
+        # must be generator-made, i.e. all fields are ResultArgs.
+        in_structs = [a for c, a in structs if a.typ.dir != Dir.OUT]
+        assert in_structs
+        for a in in_structs:
+            assert all(isinstance(f, ResultArg) for f in a.inner), \
+                "timespec fields must come from the special generator"
+            sec, nsec = a.inner
+            if sec.res is not None or nsec.res is not None:
+                saw_gettime += 1
+                # absolute few-ms-ahead: nsec chains with an op_add
+                assert nsec.op_add in (10_000_000, 30_000_000)
+            elif nsec.val in (10_000_000, 30_000_000):
+                saw_small += 1
+            else:
+                assert (sec.val, nsec.val) in ((0, 0), (2 * 10**9, 0))
+        if any(c.meta.call_name == "clock_gettime" for c in p.calls):
+            assert saw_gettime
+    # All four branches are probabilistic; over 60 seeds the two
+    # interesting ones must each fire.
+    assert saw_gettime > 0 and saw_small > 0
+
+
+def test_timeval_uses_usec_scale():
+    t = target()
+    saw = 0
+    for seed in range(80):
+        from syzkaller_tpu.prog.analysis import analyze
+        from syzkaller_tpu.prog.prog import Prog
+
+        r = RandGen(t, seed=seed)
+        p = Prog(t)
+        s = analyze(None, p, None)
+        arg, calls = t.special_structs["timeval"](
+            r, s, _timeval_type(t), None)
+        sec, usec = arg.inner
+        if usec.res is not None:
+            assert usec.op_div == 1000
+            assert usec.op_add in (10_000, 30_000)
+            saw += 1
+        elif usec.val:
+            assert usec.val in (10_000, 30_000)
+    assert saw > 0
+
+
+def _timeval_type(t):
+    # find the timeval StructType via a call that takes ptr[in/out, timeval]
+    meta = t.syscall_map["gettimeofday"]
+    return meta.args[0].elem
+
+
+def test_round_trip_with_gettime_chain():
+    t = target()
+    for seed in range(40):
+        p = generate(t, seed, 8, None)
+        if not any(c.meta.call_name == "clock_gettime" for c in p.calls):
+            continue
+        text = serialize(p)
+        q = deserialize(t, text)
+        assert serialize(q) == text
+        q.validate()
+
+
+def test_mutation_of_deserialized_struct_keeps_res_links():
+    """Corpus programs arrive via deserialize (ConstArg fields); when
+    mutation regenerates the special struct, the res links and the chained
+    clock_gettime must survive (whole-struct replace_arg)."""
+    t = target()
+    meta = t.syscall_map["nanosleep"]
+    saw_chain = 0
+    for seed in range(120):
+        from syzkaller_tpu.prog.analysis import analyze
+        from syzkaller_tpu.prog.prog import Prog
+
+        r = RandGen(t, seed=seed)
+        p = Prog(t)
+        s = analyze(None, p, None)
+        for c in r.generate_particular_call(s, meta):
+            p.calls.append(c)
+        q = deserialize(t, serialize(p))
+        mutate(q, seed, ncalls=10, ct=None, corpus=[])
+        q.validate()
+        for c in q.calls:
+            if c.meta.call_name != "clock_gettime":
+                continue
+            # every clock_gettime present must be referenced by some
+            # ResultArg (no dead chains)
+            used = []
+            for cc in q.calls:
+                def vis(a, _b=None):
+                    if isinstance(a, ResultArg) and a.res is not None:
+                        used.append(a)
+                foreach_arg(cc, lambda a, b: foreach_subarg(a, vis))
+            if used:
+                saw_chain += 1
+    assert saw_chain > 0
+
+
+def test_mutation_keeps_generator_invariant():
+    t = target()
+    corpus = []
+    for seed in range(20):
+        p = generate(t, seed, 6, None)
+        mutate(p, seed + 1000, ncalls=8, ct=None, corpus=corpus)
+        p.validate()
+        for c, a in _collect_time_structs(p):
+            if a.typ.dir == Dir.OUT:
+                continue
+            # after mutation the struct is either untouched or regenerated —
+            # always all-ResultArg fields, never raw const garbage
+            assert all(isinstance(f, ResultArg) for f in a.inner)
+        corpus.append(p)
