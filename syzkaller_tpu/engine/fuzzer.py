@@ -51,7 +51,10 @@ class FuzzerConfig:
     log_programs: bool = False          # emit `executing program` records
     sandbox: str = "none"
     device_period: int = 16             # consume a device batch every N steps
-    mirror_bits: int = 1 << 20          # device max-signal bitset mirror
+    # device signal bitsets (sharded proxy set + host max-signal mirror):
+    # sized like ops/cover.DEFAULT_BITS — a small mirror saturates with
+    # collisions on a real corpus
+    mirror_bits: int = 1 << 26
     env_config: Optional[EnvConfig] = None
     detect_supported: bool = False      # probe the live machine (pkg/host)
     leak_check: bool = False            # kmemleak scan every leak_period
@@ -206,30 +209,31 @@ class Fuzzer:
             self.new_signal.update(fresh)
 
     def _fold_batch_signal(self, batch_sigs) -> None:
-        """Fold one device batch's executed signal into the device bitset
-        mirror with the fused one-pass kernel (ops/pallas_cover.py
-        signal_stats; exact-set bookkeeping already happened per-program
-        in execute()).  The per-batch new-bit count feeds the stats the
-        manager graphs."""
-        if self._max_bits is None or not batch_sigs:
+        """Fold one device batch's executed signal into the max-signal
+        bitset mirror (sparse scatter: at DEFAULT_BITS-scale a dense
+        per-program [B, W] pack would be gigabytes; the executed signal is
+        a few hundred PCs).  The per-batch new-bit count feeds the stats
+        the manager graphs; exact-set bookkeeping already happened
+        per-program in execute()."""
+        if self._max_bits is None:
             return
         import numpy as np
 
+        flat = [s for sigs in batch_sigs for s in sigs or ()]
+        if not flat:
+            return
         nbits = self._max_bits.shape[0] * 32
-        packed = np.zeros((len(batch_sigs), self._max_bits.shape[0]),
-                          dtype=np.uint32)
-        for i, sigs in enumerate(batch_sigs):
-            if not sigs:
-                continue
-            h = np.asarray(sigs, dtype=np.uint64) & np.uint64(nbits - 1)
-            np.bitwise_or.at(packed[i], (h >> np.uint64(5)).astype(np.int64),
-                             np.uint32(1) << (h & np.uint64(31)).astype(np.uint32))
-        from ..ops import pallas_cover
-
-        counts, merged = pallas_cover.signal_stats(self._max_bits, packed)
-        self._max_bits = np.asarray(merged, dtype=np.uint32)
+        h = np.asarray(flat, dtype=np.uint64) & np.uint64(nbits - 1)
+        words = (h >> np.uint64(5)).astype(np.int64)
+        bits = np.uint32(1) << (h & np.uint64(31)).astype(np.uint32)
+        uw, inv = np.unique(words, return_inverse=True)
+        m = np.zeros(len(uw), dtype=np.uint32)
+        np.bitwise_or.at(m, inv, bits)
+        new = m & ~self._max_bits[uw]
+        count = int(sum(int(x).bit_count() for x in new))
+        self._max_bits[uw] |= m
         self.stats["device_new_bits"] = self.stats.get(
-            "device_new_bits", 0) + int(np.asarray(counts).sum())
+            "device_new_bits", 0) + count
 
     # ---- execution ----
 
@@ -348,15 +352,111 @@ class Fuzzer:
 
     def _hints_seed(self, item: SmashItem) -> None:
         """reference executeHintSeed (fuzzer.go:627): exec with comps,
-        then exec every hint mutant."""
+        then exec every hint mutant.  With a device present the
+        (arg value x comparison) join runs as one batched XLA kernel
+        (ops/hints.py — BASELINE config[3]); the host CompMap walk is the
+        fallback and the parity reference."""
         opts = ExecOpts(collect_signal=False, collect_comps=True)
         infos = self.execute(item.prog, "exec_hints", opts)
+        if self._device is not None:
+            self._device_hints(item.prog, infos)
+            return
         comp_maps = []
         for i in range(len(item.prog.calls)):
             info = next((x for x in infos if x.index == i), None)
             comp_maps.append(CompMap.from_pairs(info.comps if info else ()))
         mutate_with_hints(item.prog, comp_maps,
                           lambda p: self.execute(p, "exec_hints"))
+
+    def _device_hints(self, p: Prog, infos: List[CallInfo]) -> None:
+        """Device hints join: every (site value, cast variant, comparison)
+        of a call tested in one broadcast compare, then the deduped
+        replacers applied as host mutants (reference prog/hints.go:33-207
+        semantics, parity-pinned by tests/test_hints.py)."""
+        import numpy as np
+
+        from ..ops import hints as dhints
+        from ..prog.generation import SPECIAL_INTS
+        from ..prog.hints import _arg_occurrences, apply_hint, hint_sites
+
+        U64 = (1 << 64) - 1
+        special = np.asarray([v & U64 for v in SPECIAL_INTS], np.uint64)
+        for ci, call in enumerate(p.calls):
+            info = next((x for x in infos if x.index == ci), None)
+            if info is None or not info.comps or \
+                    call.meta is p.target.mmap_syscall:
+                continue
+            sites = hint_sites(call)
+            if not sites:
+                continue
+            ops = np.asarray([a & U64 for a, _ in info.comps], np.uint64)
+            cargs = np.asarray([b & U64 for _, b in info.comps], np.uint64)
+            ok, rep = dhints.hint_matrix(
+                np.asarray([s[3] for s in sites], np.uint64),
+                ops, cargs, special)
+            reps, valid = dhints.unique_replacers(ok, rep, max_out=16)
+            reps = np.asarray(reps)
+            valid = np.asarray(valid)
+            self.stats["hints_device_joins"] = self.stats.get(
+                "hints_device_joins", 0) + 1
+            for si, (idx, kind, off, _val) in enumerate(sites):
+                for k in np.nonzero(valid[si])[0]:
+                    clone = p.clone()
+                    apply_hint(_arg_occurrences(clone.calls[ci])[idx],
+                               kind, off, int(reps[si, k]))
+                    self.execute(clone, "exec_hints")
+
+    # ---- device batch execution (the raw fast path) ----
+
+    def _run_device_batch(self, batch) -> None:
+        """Execute one device-mutated batch: raw exec streams go straight
+        to the executor (no Prog trees); a row is only decoded when its
+        signal is new and the program is worth triaging.  Fallback rows
+        (sanitize-special calls / codec long tail) decode eagerly and take
+        the regular execute() path."""
+        opts = ExecOpts()
+        batch_sigs = []
+        for i in range(len(batch)):
+            stream = batch.streams[i]
+            if stream is None:
+                p = batch.decode(i)
+                if p is None:
+                    continue
+                infos = self.execute(p, "exec_fuzz")
+                batch_sigs.append(sorted(
+                    {s for info in infos or () for s in info.signal}))
+                continue
+            call_ids = batch.call_ids(i)
+            if len(call_ids) <= 1:
+                continue  # mutation emptied the program: nothing to run
+            if self.cfg.log_programs:
+                # crash attribution/repro parses these records from the
+                # console log — raw streams must log like execute() does
+                p = batch.decode(i)
+                if p is not None:
+                    from ..utils.log import logf
+                    logf(0, "executing program %d:\n%s", 0, serialize(p))
+            env = self.envs[0]
+            _, infos, failed, hanged = env.exec_raw(
+                opts, stream, call_ids)
+            self.stats["exec_total"] += 1
+            self.stats["exec_fuzz"] = self.stats.get("exec_fuzz", 0) + 1
+            if failed or hanged:
+                continue
+            decoded = None
+            for info in infos:
+                diff = self._signal_diff(info.signal)
+                if not diff:
+                    continue
+                if decoded is None:
+                    decoded = batch.decode(i)
+                if decoded is not None and info.index < len(decoded.calls):
+                    self.queue.push_triage(TriageItem(
+                        prog=decoded.clone(), call_index=info.index,
+                        signal=diff))
+            batch_sigs.append(sorted(
+                {s for info in infos for s in info.signal}))
+        self._fold_batch_signal(batch_sigs)
 
     # ---- the loop ----
 
@@ -370,16 +470,15 @@ class Fuzzer:
         if (self._device is not None and self.corpus
                 and self._iter % self.cfg.device_period == 0):
             batch = self._device.candidates(self.corpus)
-            if batch:
-                self.stats["device_batches"] += 1
-                self.stats["device_candidates"] += len(batch)
-                batch_sigs = []
-                for p in batch:
-                    infos = self.execute(p, "exec_fuzz")
-                    batch_sigs.append(sorted(
-                        {s for info in infos or () for s in info.signal}))
-                self._fold_batch_signal(batch_sigs)
-                return
+            if batch is not None:
+                self.stats["device_dropped_stale"] = self.stats.get(
+                    "device_dropped_stale", 0) + batch.dropped
+                if len(batch):
+                    self.stats["device_batches"] += 1
+                    self.stats["device_candidates"] += len(batch)
+                    self._run_device_batch(batch)
+                    return
+                # fully-stale batch: fall through to regular queue work
         item = self.queue.pop()
         if isinstance(item, TriageItem):
             self.triage(item)
@@ -438,27 +537,50 @@ class _DevicePipeline:
     """Device-side candidate factory: keeps an encoded mirror of the corpus
     and emits batches of device-mutated candidates, double-buffered so the
     TPU mutates batch N+1 while the executor fleet runs batch N (SURVEY §7
-    hard part #3)."""
+    hard part #3).
+
+    The mutate/fingerprint/new-signal step is the SHARDED mesh step
+    (parallel/mesh.make_fuzz_step) over every visible device — data
+    parallelism over candidates on the ``fuzz`` axis, the word-sharded
+    proxy signal bitset on ``cover``, ICI collectives for fold and test.
+    One chip is just the 1-device mesh.  The ``fresh`` mask it returns
+    gates candidates BEFORE the host pays for emission/decode/execution —
+    stale mutants (all call fingerprints already seen) are dropped on
+    device (reference's SignalNew gate, pkg/cover/cover.go:104-117)."""
 
     def __init__(self, target, cfg: FuzzerConfig):
         import jax
+        import jax.numpy as jnp
+        import numpy as np
 
         from ..descriptions.tables import get_tables
         from ..ops.dtables import build_device_tables
-        from ..ops import mutation as dmut
+        from ..parallel import mesh as pmesh
+        from ..prog.execgen import ExecGen
         from ..prog.tensor import ProgBatch, TensorFormat, encode_prog
 
         self._jax = jax
-        self._dmut = dmut
         self.tables = get_tables(target)
         self.fmt = TensorFormat.for_tables(
             self.tables, max_calls=cfg.program_length)
         self.dt = build_device_tables(self.tables, self.fmt)
-        self.B = cfg.device_batch
         self._ProgBatch = ProgBatch
         self._encode_prog = encode_prog
+        self._execgen = ExecGen(self.tables, self.fmt)
+        self.mesh = pmesh.make_mesh()
+        self.n_fuzz, self.n_cover = self.mesh.devices.shape
+        # batch must divide the fuzz axis; round up
+        self.B = -(-cfg.device_batch // self.n_fuzz) * self.n_fuzz
+        self._step, self._shardings = pmesh.make_fuzz_step(
+            self.mesh, self.dt)
+        # the sharded bitset mapping requires power-of-two total bits
+        # (parallel/mesh._shard_index); round up like the host mirror does
+        nbits = 1 << (cfg.mirror_bits - 1).bit_length()
+        nwords = max(nbits // 32, 32 * self.n_cover)
+        self._sig_shard = jax.device_put(
+            jnp.zeros(nwords, jnp.uint32), self._shardings["signal"])
         self._key = jax.random.PRNGKey(1)
-        self._pick = __import__("numpy").random.default_rng(1)
+        self._pick = np.random.default_rng(1)
         self._pending = None  # in-flight device computation (double buffer)
         self.target = target
         self._corpus_encoded: List = []
@@ -484,29 +606,73 @@ class _DevicePipeline:
         cid = np.stack([self._corpus_encoded[i][0] for i in idx])
         sval = np.stack([self._corpus_encoded[i][1] for i in idx])
         data = np.stack([self._corpus_encoded[i][2] for i in idx])
-        return self._dmut.mutate_batch(kmut, self.dt, cid, sval, data)
+        sb = self._shardings["batch"]
+        cid, sval, data = (jax.device_put(x, sb) for x in (cid, sval, data))
+        cid, sval, data, self._sig_shard, fresh = self._step(
+            kmut, cid, sval, data, self._sig_shard)
+        return cid, sval, data, fresh
 
-    def candidates(self, corpus: List[Prog]) -> List[Prog]:
-        """Return the previously launched batch (decoded) and launch the
-        next one."""
-        from ..prog.tensor import decode_prog
+    def candidates(self, corpus: List[Prog]) -> Optional["_DeviceBatch"]:
+        """Return the previously launched batch — raw exec streams with a
+        lazy per-row decoder — and launch the next one.
 
+        Stale rows (fresh mask false) are dropped here, before the host
+        pays for emission; the fast host boundary (prog/execgen.py) then
+        emits executor wire bytes straight from the tensors (~20x the
+        decode_prog walk), and a Prog tree is only materialized for rows
+        the engine actually wants to triage."""
         import numpy as np
 
         done = self._pending
         self._pending = self._launch()
         if done is None:
-            return []
-        cid, sval, data = (np.asarray(x) for x in done)
+            return None
+        cid, sval, data, fresh = (np.asarray(x) for x in done)
+        keep = np.nonzero(fresh)[0]
+        dropped = int(cid.shape[0] - keep.size)
+        if keep.size < cid.shape[0]:
+            cid, sval, data = cid[keep], sval[keep], data[keep]
         batch = self._ProgBatch(call_id=cid, slot_val=sval, data=data)
-        out: List[Prog] = []
-        for i in range(cid.shape[0]):
-            try:
-                p = decode_prog(self.tables, self.fmt, batch, i)
-            except Exception:
-                continue
-            for c in p.calls:
-                self.target.sanitize_call(c)
-                assign_sizes_call(self.target, c)
-            out.append(p)
-        return out
+        streams = self._execgen.emit_batch(batch)
+        return _DeviceBatch(self, batch, streams, dropped=dropped)
+
+
+class _DeviceBatch:
+    """One device-mutated candidate batch: raw exec streams (None where the
+    row needs the decode fallback) plus lazy row decoding for triage."""
+
+    def __init__(self, pipe: "_DevicePipeline", batch, streams,
+                 dropped: int = 0):
+        self.pipe = pipe
+        self.batch = batch
+        self.streams = streams
+        self.dropped = dropped  # stale rows gated off on device
+        self._decoded: Dict[int, Optional[Prog]] = {}
+
+    def __len__(self) -> int:
+        return len(self.streams)
+
+    def call_ids(self, row: int) -> List[int]:
+        """Stream call ids: prelude mmap + the row's active calls (matches
+        both the emitted stream and the decoded Prog's call list)."""
+        t = self.pipe.target
+        ids = [t.mmap_syscall.id]
+        for cid in self.batch.call_id[row]:
+            if int(cid) >= 0:
+                ids.append(int(cid))
+        return ids
+
+    def decode(self, row: int) -> Optional[Prog]:
+        if row in self._decoded:
+            return self._decoded[row]
+        from ..prog.tensor import decode_prog
+
+        p: Optional[Prog] = None
+        try:
+            # decode_prog runs assign_sizes_call + sanitize_call per call
+            p = decode_prog(self.pipe.tables, self.pipe.fmt,
+                            self.batch, row)
+        except Exception:
+            p = None
+        self._decoded[row] = p
+        return p

@@ -227,6 +227,14 @@ class Env:
              ) -> Tuple[bytes, List[CallInfo], bool, bool]:
         """Returns (output, call_infos, failed, hanged)."""
         data = serialize_for_exec(p, pid=self.pid)
+        return self.exec_raw(opts, data, [c.meta.id for c in p.calls])
+
+    def exec_raw(self, opts: ExecOpts, data: bytes, call_ids: List[int]
+                 ) -> Tuple[bytes, List[CallInfo], bool, bool]:
+        """Execute a pre-serialized exec stream (the device fast path:
+        prog/execgen.py emits these without building Prog trees).
+        `call_ids` lists the stream's syscall ids in order — used to pad
+        unreached calls, exactly like exec() pads from p.calls."""
         if len(data) > P.IN_SHM_SIZE:
             # deterministic host-side rejection; the executor is healthy,
             # don't tear it down (distinct from the crash path below)
@@ -255,7 +263,7 @@ class Env:
         # non-executed one regardless of arrival order.
         by_index: dict = {}
         for info in infos:
-            if info.index >= len(p.calls):
+            if info.index >= len(call_ids):
                 continue
             prev = by_index.get(info.index)
             if prev is None or (info.executed and not prev.executed):
@@ -265,10 +273,10 @@ class Env:
         # exit(), hang kill) as not-executed, errno=-1 — one info per call,
         # like the reference's ipc (reference pkg/ipc/ipc_linux.go fills
         # len(p.Calls) infos and leaves unexecuted ones marked).
-        for idx, call in enumerate(p.calls):
+        for idx, num in enumerate(call_ids):
             if idx not in by_index:
                 infos.append(CallInfo(
-                    index=idx, num=call.meta.id, errno=-1,
+                    index=idx, num=num, errno=-1,
                     executed=False, fault_injected=False,
                     signal=[], cover=[], comps=[]))
         infos.sort(key=lambda i: i.index)
@@ -342,27 +350,56 @@ class MockEnv:
 
     def exec(self, opts: ExecOpts, p: Prog
              ) -> Tuple[bytes, List[CallInfo], bool, bool]:
-        from ..prog.prog import ConstArg, PointerArg, ResultArg
+        # Delegate through the wire format so tree-serialized programs and
+        # device-emitted raw streams of the same program fingerprint
+        # IDENTICALLY — a divergence would make raw-discovered signal
+        # unreproducible by triage's tree re-execution and push the same
+        # candidates forever.
+        from ..prog.encodingexec import serialize_for_exec
 
+        data = serialize_for_exec(p, pid=self.pid)
+        return self.exec_raw(opts, data, [c.meta.id for c in p.calls])
+
+    def exec_raw(self, opts: ExecOpts, data: bytes, call_ids: List[int]
+                 ) -> Tuple[bytes, List[CallInfo], bool, bool]:
+        """Synthesize deterministic signal from the decoded instruction
+        stream (the one authority for both exec() and the raw path).
+        Pointer-valued consts (>= data_offset) fingerprint as pointers."""
+        from ..prog.encodingexec import decode_exec
+
+        data_off = getattr(self.target, "data_offset", 512 << 20)
         infos: List[CallInfo] = []
-        for i, c in enumerate(p.calls):
-            h = self._mix(c.meta.id * 2654435761)
+        i = 0
+        for ins in decode_exec(data):
+            if ins["op"] != "call":
+                continue
+            cid = ins["id"]
+            h = self._mix(cid * 2654435761)
             sig = [h % self.signal_space]
-            # one extra edge per distinct const-arg magnitude class, so
-            # mutation that changes values can find "new coverage"
-            for a in c.args:
-                if isinstance(a, ConstArg):
-                    cls = min(a.val.bit_length(), 16)
-                    sig.append(self._mix(h ^ (cls + 1)) % self.signal_space)
-                elif isinstance(a, PointerArg):
-                    sig.append(self._mix(h ^ 0x9999) % self.signal_space)
-                elif isinstance(a, ResultArg) and a.res is not None:
+            comps = []
+            for a in ins["args"]:
+                if a["kind"] == "const":
+                    if a["value"] >= data_off:
+                        sig.append(self._mix(h ^ 0x9999) % self.signal_space)
+                    else:
+                        cls = min(int(a["value"]).bit_length(), 16)
+                        sig.append(self._mix(h ^ (cls + 1))
+                                   % self.signal_space)
+                        if opts.collect_comps:
+                            # a deterministic "kernel comparison" per const
+                            # arg so the hermetic loop can exercise the
+                            # full hints join+mutate pipeline
+                            v = int(a["value"])
+                            comps.append((v, (v ^ 0x2A) & ((1 << 64) - 1)))
+                elif a["kind"] == "result":
                     sig.append(self._mix(h ^ 0x5555) % self.signal_space)
             infos.append(CallInfo(
-                index=i, num=c.meta.id, errno=0, executed=True,
+                index=i, num=cid, errno=0, executed=True,
                 fault_injected=False,
                 signal=sig if opts.collect_signal else [],
-                cover=sig if opts.collect_cover else []))
+                cover=sig if opts.collect_cover else [],
+                comps=comps if opts.collect_comps else []))
+            i += 1
         return b"", infos, False, False
 
 

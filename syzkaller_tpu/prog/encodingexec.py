@@ -88,8 +88,17 @@ def physical_addr(target, arg: PointerArg) -> int:
 
 
 def serialize_for_exec(p: Prog, pid: int = 0,
-                       limit: int = EXEC_BUFFER_SIZE) -> bytes:
-    """Serialize program p for execution by process `pid`."""
+                       limit: int = EXEC_BUFFER_SIZE,
+                       trace=None) -> bytes:
+    """Serialize program p for execution by process `pid`.
+
+    `trace(role, arg, word_index)` (optional) is called for every word
+    whose value depends on per-program state — the hook prog/execgen.py
+    uses to compile static per-syscall exec templates with patch tables.
+    Roles: "value" (ConstArg value word), "result" (ResultArg 5-word group
+    start), "addr" (any word containing a page-derived physical address),
+    "data" (payload word run start), "call" (the call-id word).
+    """
     target = p.target
     w = _Writer(limit)
     # arg identity -> (physical addr, instruction index)
@@ -97,10 +106,15 @@ def serialize_for_exec(p: Prog, pid: int = 0,
     idx_of: Dict[int, int] = {}
     instr_seq = 0
 
+    def pos() -> int:
+        return w.size // 8
+
     def write_arg(arg: Arg) -> None:
         if isinstance(arg, ConstArg):
             w.word(EXEC_ARG_CONST)
             w.word(arg.size())
+            if trace is not None:
+                trace("value", arg, pos())
             # csum fields must land as zero: the executor's checksum
             # instruction sums the enclosing range with this field included
             # before overwriting it (a stray value would poison the sum).
@@ -108,6 +122,8 @@ def serialize_for_exec(p: Prog, pid: int = 0,
             w.word(arg.typ.bitfield_offset)
             w.word(arg.typ.bitfield_length)
         elif isinstance(arg, ResultArg):
+            if trace is not None:
+                trace("result", arg, pos())
             if arg.res is None:
                 w.word(EXEC_ARG_CONST)
                 w.word(arg.size())
@@ -123,12 +139,16 @@ def serialize_for_exec(p: Prog, pid: int = 0,
         elif isinstance(arg, PointerArg):
             w.word(EXEC_ARG_CONST)
             w.word(arg.size())
+            if trace is not None:
+                trace("addr", arg, pos())
             w.word(physical_addr(target, arg))
             w.word(0)
             w.word(0)
         elif isinstance(arg, DataArg):
             w.word(EXEC_ARG_DATA)
             w.word(len(arg.data))
+            if trace is not None:
+                trace("data", arg, pos())
             w.data(arg.data)
         else:
             raise TypeError(f"cannot exec-serialize arg {arg}")
@@ -152,6 +172,8 @@ def serialize_for_exec(p: Prog, pid: int = 0,
                 if is_pad(sub.typ) or sub.typ.dir == Dir.OUT:
                     return
                 w.word(EXEC_INSTR_COPYIN)
+                if trace is not None:
+                    trace("addr", arg, pos())
                 w.word(base_addr + offset)
                 write_arg(sub)
                 instr_seq += 1
@@ -169,6 +191,8 @@ def serialize_for_exec(p: Prog, pid: int = 0,
             base_addr = physical_addr(target, arg)
             for ci in calc_checksums(arg.res):
                 w.word(EXEC_INSTR_COPYIN)
+                if trace is not None:
+                    trace("addr", arg, pos())
                 w.word(base_addr + ci.offset)
                 w.word(EXEC_ARG_CSUM)
                 w.word(ci.size)
@@ -177,6 +201,8 @@ def serialize_for_exec(p: Prog, pid: int = 0,
                 for ch in ci.chunks:
                     w.word(ch.kind)
                     if ch.kind == EXEC_ARG_CSUM_CHUNK_DATA:
+                        if trace is not None:
+                            trace("addr", arg, pos())
                         w.word(base_addr + ch.value)
                     else:
                         w.word(ch.value)
@@ -187,6 +213,8 @@ def serialize_for_exec(p: Prog, pid: int = 0,
             foreach_subarg(a, gen_csums)
 
         # --- the call itself ---
+        if trace is not None:
+            trace("call", c, pos())
         w.word(c.meta.id)
         w.word(len(c.args))
         for a in c.args:
@@ -200,6 +228,8 @@ def serialize_for_exec(p: Prog, pid: int = 0,
             nonlocal instr_seq
             if isinstance(arg, ResultArg) and arg.uses:
                 w.word(EXEC_INSTR_COPYOUT)
+                if trace is not None:
+                    trace("copyout", arg, pos())
                 w.word(addr_of[id(arg)])
                 w.word(arg.size())
                 idx_of[id(arg)] = instr_seq

@@ -1,0 +1,509 @@
+"""Direct tensor-batch -> exec-stream emission (the fast host boundary).
+
+The device mutates candidates at ~10^5 progs/s but the per-program
+decode_prog -> Prog -> serialize_for_exec round-trip walks Python trees at
+~10^3 progs/s, capping the end-to-end loop (SURVEY §7 hard part #3).  This
+module removes the round-trip: because the tensor encoding is built on
+*static per-syscall templates* (descriptions/tables.py), the exec-format
+word stream of a call (reference prog/encodingexec.go:14-288) is itself
+static per syscall id up to a small set of patchable words — argument
+values, resource-result indices, page-derived addresses, payload bytes.
+
+Template build: serialize_for_exec runs once per syscall id over the
+cap-filled template tree with a trace hook recording which word positions
+hold patchable quantities.  Batch emission then copies the template words
+and patches them with numpy ops per (row, call) — no tree construction,
+no per-word Python.
+
+Fidelity contract vs the decode path (pinned by tests/test_execgen.py):
+  - byte-identical to serialize_for_exec(decode_prog(row)) whenever every
+    DATA slot's length value >= its cap (the template instantiation);
+  - for shorter dynamic lengths the fast path pins payloads at cap (the
+    kernel sees a legal full-cap buffer) — the device alley trades length
+    exploration for throughput; generate/mutate/smash keep full dynamism;
+  - rows containing sanitize-special calls (mmap/mremap/exit/exit_group,
+    whose decode applies target.sanitize_call rewrites) return None and
+    the caller falls back to decode_prog.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..descriptions.tables import (
+    SK_DATA,
+    SK_LEN,
+    SK_PTR,
+    SK_REF,
+    SK_VALUE,
+    SK_VMA,
+    CompiledTables,
+)
+from .analysis import assign_sizes_call
+from .encodingexec import (
+    EXEC_INSTR_COPYOUT,
+    EXEC_INSTR_EOF,
+    decode_exec,
+    serialize_for_exec,
+)
+from .prog import (
+    Call,
+    ConstArg,
+    DataArg,
+    PointerArg,
+    Prog,
+    ResultArg,
+    ReturnArg,
+    foreach_subarg,
+    foreach_subarg_offset,
+)
+from .tensor import (
+    REF_NONE,
+    VMA_MAX_PAGES,
+    ProgBatch,
+    TensorFormat,
+    _find_source,
+    template_arg,
+    walk_slots,
+)
+from .types import Dir, ProcType, ResourceType, UINT64_MAX, VmaType
+
+U64 = np.uint64
+
+# Calls whose decode applies target.sanitize_call rewrites.  For linux the
+# three rewrites are pure per-slot value transforms the emitter vectorizes
+# (see _SANITIZE_OPS); other targets fall back to the decode path for them.
+SANITIZE_CALLS = {"mmap", "mremap", "exit", "exit_group"}
+
+
+@dataclass
+class _CallTemplate:
+    words: np.ndarray                    # u64 [L] static skeleton
+    n_instr: int                         # copyins + csums + the call itself
+    addr_pos: np.ndarray                 # word positions holding page-derived addrs
+    # SK_VALUE patches (vectorized)
+    val_pos: np.ndarray
+    val_slot: np.ndarray
+    val_proc_start: np.ndarray           # u64; 0 for non-proc
+    val_proc_per: np.ndarray             # u64; 0 for non-proc
+    val_be: List[Tuple[int, int]]        # (patch idx, byte size) big-endian swaps
+    # sanitize transforms: (patch idx, op, a, b); op in
+    # "exit" (v%128 in {67,68} -> 1), "or" (v|a), "ifand_or" (v|b if v&a)
+    val_san: List[Tuple[int, str, int, int]]
+    # SK_REF patches: (group word pos, slot, size)
+    refs: List[Tuple[int, int, int]]
+    ref_res_name: List[str]              # resource name per ref entry
+    # SK_VMA patches: (addr word pos, slot)
+    vmas: List[Tuple[int, int]]
+    # LEN-of-vma patches: (value word pos, target slot)
+    vma_lens: List[Tuple[int, int]]
+    # payload byte runs: (byte offset in stream, arena offset, cap)
+    datas: List[Tuple[int, int, int]]
+    # copyout candidates: DFS rank -> (rank, addr const, size, paged)
+    copyout: Dict[int, Tuple[int, int, int, bool]]
+    copyout_rank_of: Dict[int, int]      # id(template node) -> rank
+    resolve: Dict[str, object] = field(default_factory=dict)  # res -> "ret"|si|None
+    tree_call: object = None             # template Call (for _find_source)
+
+
+def _swap(v: int, size: int) -> int:
+    return int.from_bytes(int(v).to_bytes(size, "little"), "big")
+
+
+class ExecGen:
+    def __init__(self, tables: CompiledTables, fmt: TensorFormat):
+        self.tables = tables
+        self.fmt = fmt
+        self.target = tables.target
+        self.psize = self.target.page_size
+        self._tmpl: Dict[int, Optional[_CallTemplate]] = {}
+        self._prelude: Optional[Tuple[np.ndarray, int]] = None  # words, len pos
+
+    # ---- template build -------------------------------------------------
+
+    def _build_prelude(self) -> Tuple[np.ndarray, int]:
+        if self._prelude is None:
+            c = self.target.make_mmap(0, 1)
+            length_arg = c.args[1]
+            traces: List[Tuple[str, object, int]] = []
+            data = serialize_for_exec(
+                Prog(self.target, [c]), 0,
+                trace=lambda r, a, i: traces.append((r, a, i)))
+            words = np.frombuffer(data, dtype=np.uint64)[:-1].copy()  # drop EOF
+            pos = next(i for r, a, i in traces
+                       if r == "value" and a is length_arg)
+            self._prelude = (words, pos)
+        return self._prelude
+
+    def _template(self, cid: int) -> Optional[_CallTemplate]:
+        if cid in self._tmpl:
+            return self._tmpl[cid]
+        t = None
+        try:
+            t = self._build_template(cid)
+        except Exception:
+            t = None
+        self._tmpl[cid] = t
+        return t
+
+    def _build_template(self, cid: int) -> Optional[_CallTemplate]:
+        tables, fmt = self.tables, self.fmt
+        meta = self.target.syscalls[cid]
+        if meta.call_name in SANITIZE_CALLS and (
+                self.target.os != "linux"
+                or "MAP_FIXED" not in self.target.consts):
+            return None
+        args = [template_arg(tt) for tt in meta.args]
+        call = Call(meta=meta, args=args,
+                    ret=ReturnArg(meta.ret) if meta.ret is not None
+                    else ReturnArg(None))
+
+        off = int(tables.call_slot_off[cid])
+        cnt = int(tables.call_slot_cnt[cid])
+        bo = int(tables.call_block_off[cid])
+        limit = min(cnt, fmt.max_slots)
+
+        # slot map over the template tree (the exact decode_prog walk)
+        slot_of: Dict[int, Tuple[int, int]] = {}
+        slots: List[Tuple[object, int]] = []
+        for si, (arg, kind) in enumerate(walk_slots(args)):
+            slots.append((arg, kind))
+            if si < limit:
+                slot_of[id(arg)] = (si, kind)
+
+        # instantiate at template shape: caps for payloads, table layout
+        # for pointers/vmas (mirrors decode_prog with call_page=1)
+        datas_arena: Dict[int, int] = {}
+        patched_ptrs: set = set()
+        for si, (arg, kind) in enumerate(slots):
+            if si >= limit:
+                break
+            if kind == SK_DATA:
+                cap = int(tables.slot_size[off + si])
+                blk = int(tables.slot_block[off + si])
+                if blk >= 0:
+                    base = int(tables.block_addr[bo + blk]) + \
+                        int(tables.slot_offset[off + si])
+                    datas_arena[si] = base
+                arg.data = b"\x00" * cap
+            elif kind == SK_PTR:
+                blk = int(tables.slot_target_block[off + si])
+                if isinstance(arg, PointerArg) and blk >= 0:
+                    arg.page_index = 1
+                    arg.page_offset = int(tables.block_addr[bo + blk])
+                    patched_ptrs.add(id(arg))
+            elif kind == SK_VMA:
+                arg.page_index = 0
+                arg.page_offset = 0
+                arg.pages_num = 1
+        assign_sizes_call(self.target, call)
+
+        traces: List[Tuple[str, object, int]] = []
+        data = serialize_for_exec(
+            Prog(self.target, [call]), 0,
+            trace=lambda r, a, i: traces.append((r, a, i)))
+        words = np.frombuffer(data, dtype=np.uint64)[:-1].copy()  # drop EOF
+        n_instr = sum(1 for ins in decode_exec(data)
+                      if ins["op"] in ("copyin", "call"))
+
+        addr_pos: List[int] = []
+        val_pos: List[int] = []
+        val_slot: List[int] = []
+        val_ps: List[int] = []
+        val_pp: List[int] = []
+        val_be: List[Tuple[int, int]] = []
+        refs: List[Tuple[int, int, int]] = []
+        ref_res: List[str] = []
+        vmas: List[Tuple[int, int]] = []
+        vma_lens: List[Tuple[int, int]] = []
+        datas: List[Tuple[int, int, int]] = []
+
+        vma_slots = {si for si, (a, k) in enumerate(slots)
+                     if k == SK_VMA and si < limit}
+        vma_args = {id(a): si for si, (a, k) in enumerate(slots)
+                    if k == SK_VMA and si < limit}
+
+        for role, arg, pos in traces:
+            if role == "addr":
+                if id(arg) in vma_args:
+                    vmas.append((pos, vma_args[id(arg)]))
+                elif id(arg) in patched_ptrs:
+                    # only pointers decode rebased onto the call page get
+                    # the per-row page term; slotless / blockless pointers
+                    # stay at page 0 in both paths
+                    addr_pos.append(pos)
+            elif role == "value":
+                ent = slot_of.get(id(arg))
+                if ent is None:
+                    continue
+                si, kind = ent
+                if kind == SK_VALUE:
+                    tt = arg.typ
+                    val_pos.append(pos)
+                    val_slot.append(si)
+                    if isinstance(tt, ProcType):
+                        val_ps.append(tt.values_start)
+                        val_pp.append(tt.values_per_proc)
+                    else:
+                        val_ps.append(0)
+                        val_pp.append(0)
+                    if getattr(tt, "big_endian", False):
+                        val_be.append((len(val_pos) - 1, tt.size))
+                elif kind == SK_LEN:
+                    # only vma-targeting lens are dynamic in the fast path
+                    lt = int(tables.slot_len_target[off + si]) \
+                        if si < cnt else -1
+                    if lt in vma_slots:
+                        vma_lens.append((pos, lt))
+            elif role == "result":
+                ent = slot_of.get(id(arg))
+                if ent is None:
+                    continue
+                si, kind = ent
+                if kind == SK_REF:
+                    refs.append((pos, si, arg.size()))
+                    ref_res.append(arg.typ.desc.name)
+                elif kind == SK_VALUE:
+                    # out-dir resource slot: raw val patch (ResultArg path
+                    # writes arg.val with no endian/proc transform) — the
+                    # value word is group word 2
+                    val_pos.append(pos + 2)
+                    val_slot.append(si)
+                    val_ps.append(0)
+                    val_pp.append(0)
+            elif role == "data":
+                ent = slot_of.get(id(arg))
+                if ent is None or ent[1] != SK_DATA:
+                    continue
+                si = ent[0]
+                if si in datas_arena:
+                    datas.append((pos * 8, datas_arena[si],
+                                  int(tables.slot_size[off + si])))
+
+        # copyout candidates: out-dir resource nodes inside pointees, with
+        # addresses from the copyin layout and ranks in gen_copyouts' DFS
+        # order (encodingexec.py:gen_copyouts — full foreach_subarg walk,
+        # which interleaves nested pointees at their pointer's position)
+        from .encodingexec import physical_addr
+
+        addr_map: Dict[int, Tuple[int, bool]] = {}
+
+        def per_ptr(parg, _b):
+            if not isinstance(parg, PointerArg) or parg.res is None:
+                return
+            base = physical_addr(self.target, parg)
+            paged = id(parg) in patched_ptrs
+            foreach_subarg_offset(
+                parg.res,
+                lambda sub, offset: addr_map.__setitem__(
+                    id(sub), (base + offset, paged)))
+
+        for a in call.args:
+            foreach_subarg(a, per_ptr)
+
+        # keyed by DFS rank, not slot index: decode's _find_source can bind
+        # to out-dir nodes beyond the slot budget (large structs), and the
+        # copyout must still be emitted for them
+        copyout: Dict[int, Tuple[int, int, int]] = {}
+        copyout_rank_of: Dict[int, int] = {}
+        rank = [0]
+
+        def per_node(sub, _b):
+            if isinstance(sub, ResultArg) and \
+                    isinstance(sub.typ, ResourceType) and \
+                    sub.typ.dir != Dir.IN and id(sub) in addr_map:
+                addr, paged = addr_map[id(sub)]
+                copyout[rank[0]] = (rank[0], addr, sub.size(), paged)
+                copyout_rank_of[id(sub)] = rank[0]
+                rank[0] += 1
+
+        for a in call.args:
+            foreach_subarg(a, per_node)
+
+        # vectorized sanitize_call equivalents (descriptions/linux/__init__
+        # sanitize_call): pure value transforms on one top-level arg slot
+        val_san: List[Tuple[int, str, int, int]] = []
+        cn = meta.call_name
+        if cn in SANITIZE_CALLS:
+            san_arg = {"mmap": 3, "mremap": 3, "exit": 0, "exit_group": 0}[cn]
+            cm = self.target.consts
+            for pi, si in enumerate(val_slot):
+                if si < cnt and tables.slot_is_arg[off + si] and \
+                        int(tables.slot_arg_idx[off + si]) == san_arg:
+                    if cn == "mmap":
+                        val_san.append((pi, "or", cm["MAP_FIXED"], 0))
+                    elif cn == "mremap":
+                        val_san.append((pi, "ifand_or",
+                                        cm["MREMAP_MAYMOVE"],
+                                        cm["MREMAP_FIXED"]))
+                    else:
+                        val_san.append((pi, "exit", 0, 0))
+                    break
+
+        return _CallTemplate(
+            words=words, n_instr=n_instr,
+            addr_pos=np.asarray(addr_pos, dtype=np.int64),
+            val_pos=np.asarray(val_pos, dtype=np.int64),
+            val_slot=np.asarray(val_slot, dtype=np.int64),
+            val_proc_start=np.asarray(val_ps, dtype=np.uint64),
+            val_proc_per=np.asarray(val_pp, dtype=np.uint64),
+            val_be=val_be, val_san=val_san, refs=refs,
+            ref_res_name=ref_res,
+            vmas=vmas, vma_lens=vma_lens, datas=datas, copyout=copyout,
+            copyout_rank_of=copyout_rank_of, tree_call=call,
+        )
+
+    def _resolve(self, tmpl: _CallTemplate, res_name: str):
+        """How a consumer wanting `res_name` binds to this producer call:
+        "ret", an inner copyout slot index, or None — memoized; mirrors
+        decode_prog's _find_source over the template tree exactly."""
+        if res_name in tmpl.resolve:
+            return tmpl.resolve[res_name]
+        out = None
+        desc = self.target.resource_map.get(res_name)
+        if desc is not None:
+            # any ResourceType of that desc will do for _find_source
+            res_type = ResourceType(name=res_name, desc=desc)
+            src = _find_source(tmpl.tree_call, res_type, self.target)
+            if src is not None and src is tmpl.tree_call.ret:
+                out = "ret"
+            elif src is not None:
+                # only copyout candidates (out-dir resources inside
+                # pointees) are addressable
+                out = tmpl.copyout_rank_of.get(id(src))
+        tmpl.resolve[res_name] = out
+        return out
+
+    # ---- emission -------------------------------------------------------
+
+    def emit_row(self, batch: ProgBatch, row: int, pid: int = 0
+                 ) -> Optional[bytes]:
+        tables, fmt, psize = self.tables, self.fmt, self.psize
+        call_id = batch.call_id[row]
+        slot_val = batch.slot_val[row]
+        data = batch.data[row]
+
+        active: List[Tuple[int, _CallTemplate]] = []
+        for ci in range(fmt.max_calls):
+            cid = int(call_id[ci])
+            if cid < 0:
+                continue
+            tmpl = self._template(cid)
+            if tmpl is None:
+                return None  # fallback row
+            active.append((ci, tmpl))
+
+        if not active:
+            # decode_prog of an empty row yields a call-less prog: no
+            # mmap prelude, just EOF
+            return np.asarray([EXEC_INSTR_EOF], dtype=np.uint64).tobytes()
+
+        # pass 1: resolve refs -> per-call used copyout slots
+        used: List[set] = [set() for _ in active]
+        resolved: List[List[Optional[Tuple[int, object]]]] = []
+        for k, (ci, tmpl) in enumerate(active):
+            res_k: List[Optional[Tuple[int, object]]] = []
+            for (pos, si, size), rname in zip(tmpl.refs, tmpl.ref_res_name):
+                v = int(slot_val[ci, si])
+                if v == REF_NONE or v >= k:
+                    res_k.append(None)
+                    continue
+                how = self._resolve(active[v][1], rname)
+                if how is None:
+                    res_k.append(None)
+                elif how == "ret":
+                    res_k.append((v, "ret"))
+                else:
+                    used[v].add(how)
+                    res_k.append((v, how))
+            resolved.append(res_k)
+
+        # pass 2: instruction numbering (prelude mmap is instr 0)
+        cursor = 1
+        call_instr: List[int] = []
+        copyout_idx: List[Dict[int, int]] = []
+        for k, (ci, tmpl) in enumerate(active):
+            call_instr.append(cursor + tmpl.n_instr - 1)
+            cursor += tmpl.n_instr
+            cmap: Dict[int, int] = {}
+            for si in sorted(used[k], key=lambda s: tmpl.copyout[s][0]):
+                cmap[si] = cursor
+                cursor += 1
+            copyout_idx.append(cmap)
+
+        # pass 3: emit
+        vma_cursor = fmt.max_calls + 1
+        pieces: List[np.ndarray] = []
+        for k, (ci, tmpl) in enumerate(active):
+            page = 1 + k
+            w = tmpl.words.copy()
+            if tmpl.addr_pos.size:
+                w[tmpl.addr_pos] += U64((page - 1) * psize)
+            if tmpl.val_pos.size:
+                # proc values: serialize adds start + per*pid to the raw val
+                vals = slot_val[ci][tmpl.val_slot] + tmpl.val_proc_start + \
+                    tmpl.val_proc_per * U64(pid)
+                for pi, op, a, b in tmpl.val_san:
+                    v = int(vals[pi])
+                    if op == "or":
+                        v |= a
+                    elif op == "ifand_or" and v & a:
+                        v |= b
+                    elif op == "exit" and v % 128 in (67, 68):
+                        v = 1
+                    vals[pi] = U64(v)
+                w[tmpl.val_pos] = vals
+                for pi, sz in tmpl.val_be:
+                    w[tmpl.val_pos[pi]] = U64(_swap(
+                        int(vals[pi]) & ((1 << (8 * sz)) - 1), sz))
+            for ri, ent in enumerate(resolved[k]):
+                if ent is None:
+                    continue
+                pos, _si, _size = tmpl.refs[ri]
+                v, how = ent
+                w[pos] = U64(1)  # EXEC_ARG_RESULT
+                if how == "ret":
+                    w[pos + 2] = U64(call_instr[v])
+                else:
+                    w[pos + 2] = U64(copyout_idx[v][how])
+                w[pos + 3] = U64(0)
+                w[pos + 4] = U64(0)
+            for pos, si in tmpl.vmas:
+                pages = max(1, min(int(slot_val[ci, si]), VMA_MAX_PAGES))
+                w[pos] = U64(self.target.data_offset + vma_cursor * psize)
+                vma_cursor += pages
+                # remember per-slot page count for the len patch below
+            for pos, si in tmpl.vma_lens:
+                pages = max(1, min(int(slot_val[ci, si]), VMA_MAX_PAGES))
+                w[pos] = U64(pages * psize)
+            if tmpl.datas:
+                bv = w.view(np.uint8)
+                for bpos, abase, cap in tmpl.datas:
+                    bv[bpos:bpos + cap] = data[ci, abase:abase + cap]
+            pieces.append(w)
+            if copyout_idx[k]:
+                co = np.empty(3 * len(copyout_idx[k]), dtype=np.uint64)
+                j = 0
+                for si in sorted(copyout_idx[k],
+                                 key=lambda s: tmpl.copyout[s][0]):
+                    _rank, addr, size, paged = tmpl.copyout[si]
+                    co[j] = U64(EXEC_INSTR_COPYOUT)
+                    co[j + 1] = U64(addr + (page - 1) * psize if paged
+                                    else addr)
+                    co[j + 2] = U64(size)
+                    j += 3
+                pieces.append(co)
+
+        prelude, len_pos = self._build_prelude()
+        pre = prelude.copy()
+        npages = max(vma_cursor, 1 + len(active))
+        pre[len_pos] = U64(npages * psize)
+        eof = np.asarray([EXEC_INSTR_EOF], dtype=np.uint64)
+        return np.concatenate([pre, *pieces, eof]).tobytes()
+
+    def emit_batch(self, batch: ProgBatch, pid: int = 0
+                   ) -> List[Optional[bytes]]:
+        return [self.emit_row(batch, r, pid) for r in range(batch.batch)]

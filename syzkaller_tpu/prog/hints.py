@@ -111,33 +111,46 @@ def _arg_occurrences(call: Call) -> List:
     return out
 
 
+def hint_sites(call: Call) -> List:
+    """Every mutable hint site of a call as (occurrence idx, kind, byte
+    offset, observed u64 value) — the one site-enumeration authority shared
+    by the host path below and the device join (engine _device_hints)."""
+    out: List = []
+    for idx, arg in enumerate(_arg_occurrences(call)):
+        if isinstance(arg, ConstArg):
+            out.append((idx, "const", 0, arg.val & UINT64_MASK))
+        elif isinstance(arg, DataArg) and arg.typ.dir in (Dir.IN, Dir.INOUT):
+            data = bytes(arg.data)
+            for off in range(min(len(data), MAX_DATA_LENGTH)):
+                out.append((idx, "data", off, _bytes_to_u64(data, off)))
+    return out
+
+
+def apply_hint(arg, kind: str, off: int, rep: int) -> None:
+    """Apply one replacer to a (cloned) site arg: const value assignment or
+    an 8-byte little-endian splice into the data payload."""
+    if kind == "const":
+        arg.val = rep & UINT64_MASK
+    else:
+        data = bytearray(arg.data)
+        chunk = (rep & UINT64_MASK).to_bytes(8, "little")
+        n = min(8, len(data) - off)
+        data[off:off + n] = chunk[:n]
+        arg.data = bytes(data)
+
+
 def _hint_call(p: Prog, ci: int, comps: CompMap,
                exec_cb: Callable[[Prog], None]) -> int:
     # Enumerate mutation sites on the original; apply each to a fresh clone,
     # locating the arg by occurrence index (clone preserves structure).
-    sites: List = []  # (occurrence idx, kind, replacer, byte offset)
-    for idx, arg in enumerate(_arg_occurrences(p.calls[ci])):
-        if isinstance(arg, ConstArg):
-            for rep in sorted(shrink_expand(arg.val, comps)):
-                sites.append((idx, "const", rep, 0))
-        elif isinstance(arg, DataArg) and arg.typ.dir in (Dir.IN, Dir.INOUT):
-            data = bytes(arg.data)
-            for off in range(min(len(data), MAX_DATA_LENGTH)):
-                for rep in sorted(shrink_expand(_bytes_to_u64(data, off),
-                                                comps)):
-                    sites.append((idx, "data", rep, off))
+    mutants: List = []  # (occurrence idx, kind, byte offset, replacer)
+    for idx, kind, off, val in hint_sites(p.calls[ci]):
+        for rep in sorted(shrink_expand(val, comps)):
+            mutants.append((idx, kind, off, rep))
 
-    for idx, kind, rep, off in sites:
+    for idx, kind, off, rep in mutants:
         clone = p.clone()
-        target_arg = _arg_occurrences(clone.calls[ci])[idx]
-        if kind == "const":
-            target_arg.val = rep
-        else:
-            data = bytearray(target_arg.data)
-            chunk = rep.to_bytes(8, "little")
-            n = min(8, len(data) - off)
-            data[off:off + n] = chunk[:n]
-            target_arg.data = bytes(data)
+        apply_hint(_arg_occurrences(clone.calls[ci])[idx], kind, off, rep)
         clone.validate()
         exec_cb(clone)
-    return len(sites)
+    return len(mutants)

@@ -1,0 +1,155 @@
+"""Parity of the fast tensor->exec emitter against the decode path.
+
+The contract (prog/execgen.py): whenever every DATA slot's length value
+is >= its cap, ExecGen.emit_row must be byte-identical to
+serialize_for_exec(decode_prog(row)) — the template instantiation the two
+paths share.  Rows containing sanitize-special calls return None.
+"""
+
+import numpy as np
+import pytest
+
+from syzkaller_tpu.descriptions.tables import SK_DATA, get_tables
+from syzkaller_tpu.prog import get_target
+from syzkaller_tpu.prog.encodingexec import serialize_for_exec
+from syzkaller_tpu.prog.execgen import ExecGen
+from syzkaller_tpu.prog.generation import generate
+from syzkaller_tpu.prog.tensor import (
+    ProgBatch,
+    TensorFormat,
+    decode_prog,
+    encode_prog,
+)
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    t = get_target("linux", "amd64")
+    tb = get_tables(t)
+    fmt = TensorFormat.for_tables(tb, max_calls=12)
+    return t, tb, fmt
+
+
+def _pin_data_caps(tb, batch):
+    """Force every DATA slot's length >= cap so decode instantiates the
+    template shape (n = min(v, cap) = cap)."""
+    for r in range(batch.batch):
+        for c in range(batch.call_id.shape[1]):
+            cid = int(batch.call_id[r, c])
+            if cid < 0:
+                continue
+            off = int(tb.call_slot_off[cid])
+            cnt = int(tb.call_slot_cnt[cid])
+            lim = min(cnt, batch.slot_val.shape[2])
+            kinds = tb.slot_kind[off:off + lim]
+            for si in np.nonzero(kinds == SK_DATA)[0]:
+                batch.slot_val[r, c, si] = np.uint64(1 << 32)
+
+
+def _assert_parity(t, tb, fmt, batch, pid=0):
+    gen = ExecGen(tb, fmt)
+    checked = skipped = 0
+    for r in range(batch.batch):
+        fast = gen.emit_row(batch, r, pid)
+        if fast is None:
+            assert _template_failed(gen, batch, r), \
+                f"row {r}: unexpected fallback"
+            skipped += 1
+            continue
+        p = decode_prog(tb, fmt, batch, r)
+        ref = serialize_for_exec(p, pid)
+        if fast != ref:
+            fw = np.frombuffer(fast, dtype=np.uint64)
+            rw = np.frombuffer(ref, dtype=np.uint64)
+            d = next((i for i in range(min(len(fw), len(rw)))
+                      if fw[i] != rw[i]), None)
+            names = [t.syscalls[int(c)].name
+                     for c in batch.call_id[r] if int(c) >= 0]
+            raise AssertionError(
+                f"row {r} {names}: lens {len(fw)}/{len(rw)}, first diff at "
+                f"word {d}: {hex(int(fw[d])) if d is not None else '-'} vs "
+                f"{hex(int(rw[d])) if d is not None else '-'}")
+        checked += 1
+    return checked, skipped
+
+
+def _template_failed(gen, batch, r):
+    for c in range(batch.call_id.shape[1]):
+        cid = int(batch.call_id[r, c])
+        if cid >= 0 and gen._tmpl.get(cid, "x") is None:
+            return True
+    return False
+
+
+def test_parity_generated_programs(ctx):
+    t, tb, fmt = ctx
+    progs = [generate(t, s, 10, None) for s in range(80)]
+    batch = ProgBatch.empty(fmt, len(progs))
+    for i, p in enumerate(progs):
+        encode_prog(tb, fmt, p, batch, i)
+    _pin_data_caps(tb, batch)
+    checked, skipped = _assert_parity(t, tb, fmt, batch)
+    assert checked >= batch.batch // 2, (checked, skipped)
+
+
+def test_parity_random_tensors(ctx):
+    """Fuzz the emitter itself: arbitrary slot values and arena bytes must
+    keep byte-parity (both paths clamp identically)."""
+    t, tb, fmt = ctx
+    rng = np.random.default_rng(11)
+    B = 48
+    batch = ProgBatch.empty(fmt, B)
+    ncalls = len(t.syscalls)
+    batch.call_id[:] = rng.integers(-1, ncalls, size=batch.call_id.shape,
+                                    dtype=np.int64).astype(np.int32)
+    batch.slot_val[:] = rng.integers(0, 1 << 63,
+                                     size=batch.slot_val.shape,
+                                     dtype=np.int64).astype(np.uint64)
+    # sprinkle REF_NONE and small ref indices
+    mask = rng.random(batch.slot_val.shape) < 0.3
+    batch.slot_val[mask] = np.uint64((1 << 64) - 1)
+    small = rng.random(batch.slot_val.shape) < 0.2
+    batch.slot_val[small] = rng.integers(
+        0, 12, size=batch.slot_val.shape, dtype=np.int64
+    ).astype(np.uint64)[small]
+    batch.data[:] = rng.integers(0, 256, size=batch.data.shape,
+                                 dtype=np.int64).astype(np.uint8)
+    _pin_data_caps(tb, batch)
+    checked, skipped = _assert_parity(t, tb, fmt, batch)
+    assert checked > 0
+
+
+def test_parity_nonzero_pid(ctx):
+    t, tb, fmt = ctx
+    progs = [generate(t, 1000 + s, 8, None) for s in range(24)]
+    batch = ProgBatch.empty(fmt, len(progs))
+    for i, p in enumerate(progs):
+        encode_prog(tb, fmt, p, batch, i)
+    _pin_data_caps(tb, batch)
+    checked, _ = _assert_parity(t, tb, fmt, batch, pid=3)
+    assert checked > 0
+
+
+def test_sanitize_calls_emit_with_parity(ctx):
+    """mmap/mremap/exit rows vectorize the linux sanitize_call rewrites
+    (MAP_FIXED OR-in, MREMAP_FIXED, exit-status 67/68 remap) instead of
+    falling back — byte parity must still hold."""
+    t, tb, fmt = ctx
+    batch = ProgBatch.empty(fmt, 3)
+    batch.call_id[0, 0] = t.syscall_map["mmap"].id
+    batch.call_id[1, 0] = t.syscall_map["exit"].id
+    batch.slot_val[1, 0, 0] = np.uint64(67)  # reserved executor status
+    batch.call_id[2, 0] = t.syscall_map["exit_group"].id
+    batch.slot_val[2, 0, 0] = np.uint64(196)  # 196 % 128 == 68
+    _pin_data_caps(tb, batch)
+    checked, skipped = _assert_parity(t, tb, fmt, batch)
+    assert checked == 3 and skipped == 0
+
+
+def test_empty_row(ctx):
+    t, tb, fmt = ctx
+    gen = ExecGen(tb, fmt)
+    batch = ProgBatch.empty(fmt, 1)
+    fast = gen.emit_row(batch, 0)
+    p = decode_prog(tb, fmt, batch, 0)
+    assert fast == serialize_for_exec(p, 0)
