@@ -319,15 +319,26 @@ class Prog:
         if isinstance(arg, ConstArg):
             arg.val = arg1.val
             arg.typ = arg1.typ
+            # a ResultArg replacement registered itself as a user of its
+            # source; it never enters the tree, so sever that edge
+            if isinstance(arg1, ResultArg) and arg1.res is not None:
+                arg1.res.uses.discard(arg1)
         elif isinstance(arg, ResultArg):
             if arg.res is not None:
                 arg.res.uses.discard(arg)
-            arg.res, arg.op_div, arg.op_add, arg.val = (
-                arg1.res, arg1.op_div, arg1.op_add, arg1.val)
+            if isinstance(arg1, ResultArg):
+                arg.res, arg.op_div, arg.op_add, arg.val = (
+                    arg1.res, arg1.op_div, arg1.op_add, arg1.val)
+                if arg.res is not None:
+                    arg.res.uses.discard(arg1)
+                    arg.res.uses.add(arg)
+            else:
+                # scalar replacement — e.g. re-generating an int field the
+                # special-struct generator had produced as a ResultArg
+                # (timespec nested inside itimerspec)
+                arg.res, arg.op_div, arg.op_add = None, 0, 0
+                arg.val = getattr(arg1, "val", 0)
             arg.typ = arg1.typ
-            if arg.res is not None:
-                arg.res.uses.discard(arg1)
-                arg.res.uses.add(arg)
         elif isinstance(arg, PointerArg):
             arg.page_index = arg1.page_index
             arg.page_offset = arg1.page_offset

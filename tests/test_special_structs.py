@@ -149,6 +149,22 @@ def test_mutation_of_deserialized_struct_keeps_res_links():
     assert saw_chain > 0
 
 
+def test_long_mutation_run_survives_nested_time_structs():
+    """Regression: timespec/timeval generated INSIDE non-special structs
+    (itimerval, itimerspec) leave ResultArg int fields the mutator may
+    later target individually — replace_arg must accept the resulting
+    ResultArg -> ConstArg scalar replacement (found by bench at ~840
+    mutations)."""
+    t = target()
+    r = RandGen(t, seed=0)
+    progs = [generate(t, i, 16) for i in range(16)]
+    for n in range(1200):
+        p = progs[n % len(progs)].clone()
+        mutate(p, r, 16, corpus=progs)
+        if n % 200 == 0:
+            p.validate()
+
+
 def test_mutation_keeps_generator_invariant():
     t = target()
     corpus = []
