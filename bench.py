@@ -35,12 +35,34 @@ Prints ONE json line: {"metric", "value", "unit", "vs_baseline",
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
 import statistics
 import sys
 import tempfile
 import time
+
+
+def _ensure_backend() -> str:
+    """Probe the jax backend before any heavy work; when the TPU/axon
+    runtime fails to initialize (BENCH_r05 tail: ``RuntimeError: Unable to
+    initialize backend 'axon'``) fall back to CPU so the round reports a
+    JSON line instead of crashing with rc=1.  Returns the platform name,
+    or "cpu-fallback" when the fallback kicked in."""
+    import jax
+
+    try:
+        return jax.devices()[0].platform
+    except RuntimeError:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            jax.clear_backends()
+        except Exception:
+            pass
+        jax.config.update("jax_platforms", "cpu")
+        jax.devices()  # raises if even CPU is unavailable — that IS fatal
+        return "cpu-fallback"
 
 
 def _sync(arrs):
@@ -292,12 +314,20 @@ def bench_hub(n_progs=4000):
     return _median_rate(run, reps=3, min_seconds=0)
 
 
-def main():
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="bench")
+    ap.add_argument("--telemetry-out", default="",
+                    help="dump the telemetry document (metrics snapshot + "
+                    "Chrome trace) to this JSON file after the run, so "
+                    "BENCH rounds carry per-phase breakdowns")
+    args = ap.parse_args(argv)
+
     from syzkaller_tpu.descriptions.tables import get_tables
     from syzkaller_tpu.ops.dtables import build_device_tables
     from syzkaller_tpu.prog import get_target
     from syzkaller_tpu.prog.tensor import TensorFormat
 
+    device = _ensure_backend()
     target = get_target("linux", "amd64")
     tables = get_tables(target)
     fmt = TensorFormat.for_tables(tables, max_calls=16)
@@ -347,6 +377,7 @@ def main():
         "value": round(dev_mut, 1),
         "unit": "progs/sec",
         "vs_baseline": round(dev_mut / host_mut, 2),
+        "device": device,
         "configs": configs,
         "baseline_note": (
             "host = this repo's single-threaded Python reimplementation "
@@ -355,6 +386,15 @@ def main():
             "vs_baseline overstates the win over real syzkaller by that "
             "factor. Host rates are median-of-5 runs of >=2s."),
     }))
+
+    # after the JSON line: a bad dump path must not cost the round its
+    # number of record
+    if args.telemetry_out:
+        from syzkaller_tpu.telemetry import telemetry_dump_to
+
+        err = telemetry_dump_to(args.telemetry_out)
+        if err:
+            print(f"telemetry dump failed: {err}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -30,11 +30,19 @@ def main(argv=None) -> int:
     ap.add_argument("-iterations", type=int, default=0,
                     help="stop after N steps (0 = run forever)")
     ap.add_argument("-leak-check", action="store_true")
+    ap.add_argument("--telemetry-out", default="",
+                    help="on exit, dump the telemetry document (metrics "
+                    "snapshot + Chrome trace) to this JSON file")
+    ap.add_argument("--no-spans", action="store_true",
+                    help="disable span tracing (counters stay on)")
     args = ap.parse_args(argv)
 
     from ..prog import get_target
+    from ..telemetry import set_spans_enabled, telemetry_dump_to
     from .fuzzer import Fuzzer, FuzzerConfig
 
+    if args.no_spans:
+        set_spans_enabled(False)
     target = get_target(args.os, args.arch)
     manager = None
     if args.manager:
@@ -60,6 +68,12 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         return 0
     finally:
+        # dump before close(): close detaches the weakref-bound gauges,
+        # which would zero fuzzer_corpus_size etc. in the document
+        if args.telemetry_out:
+            err = telemetry_dump_to(args.telemetry_out)
+            if err:
+                print(f"telemetry dump failed: {err}", file=sys.stderr)
         f.close()
 
 

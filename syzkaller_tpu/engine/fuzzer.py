@@ -21,11 +21,13 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Set
 
 from ..ipc import CallInfo, Env, EnvConfig, ExecOpts, MockEnv
 from ..prog.analysis import assign_sizes_call
+from ..telemetry import get_registry, timed
 from ..prog.encoding import serialize
 from ..prog.generation import RandGen, generate
 from ..prog.hints import CompMap, mutate_with_hints
@@ -100,6 +102,51 @@ class Fuzzer:
         self.new_signal: Set[int] = set()
         self._lock = threading.Lock()
 
+        # telemetry: self.stats stays the RPC wire shape; the registry
+        # carries the same counters plus latencies for /metrics and BENCH.
+        # Metric objects are bound once here — the hot path must pay one
+        # locked add, not a registry lookup (ISSUE 1 overhead bound).
+        reg = get_registry()
+        self.metrics = reg
+        self._m_exec_total = reg.counter(
+            "exec_total", help="programs executed")
+        self._m_new_inputs = reg.counter(
+            "new_inputs_total", help="inputs triaged into the corpus")
+        self._m_new_signal = reg.counter(
+            "new_signal_total", help="new signal PCs accepted")
+        self._m_device_batches = reg.counter(
+            "device_batches_total", help="device candidate batches consumed")
+        self._m_device_candidates = reg.counter(
+            "device_candidates_total", help="device-mutated candidates run")
+        self._h_device_batch = reg.histogram(
+            "device_batch_latency_seconds",
+            help="wall time to execute one device candidate batch")
+        self._h_triage = reg.histogram(
+            "triage_latency_seconds", help="wall time of one triage job")
+        self._h_smash = reg.histogram(
+            "smash_latency_seconds", help="wall time of one smash job")
+        self._h_generate = reg.histogram(
+            "generate_latency_seconds",
+            help="wall time of one host generation")
+        self._h_signal_fold = reg.histogram(
+            "signal_fold_seconds",
+            help="host fold of a device batch's signal into the mirror")
+        # fuzzer_-prefixed: the manager owns the bare corpus_size gauge,
+        # and in-process deployments share one registry.  Weakref-bound
+        # and detached in close(): the registry outlives fuzzer
+        # instances and must not pin a dead one's corpus alive
+        ref = weakref.ref(self)
+        self._gauge_fns = [
+            (reg.gauge("fuzzer_corpus_size",
+                       help="programs in this fuzzer's corpus"),
+             lambda: len(s.corpus) if (s := ref()) is not None else 0),
+            (reg.gauge("fuzzer_max_signal_size",
+                       help="accumulated max-signal PCs"),
+             lambda: len(s.max_signal) if (s := ref()) is not None else 0),
+        ]
+        for g, fn in self._gauge_fns:
+            g.set_fn(fn)
+
         conn = self.manager.connect()
         self._enabled = conn.get("enabled")
         if self.cfg.detect_supported:
@@ -155,6 +202,8 @@ class Fuzzer:
     def close(self) -> None:
         for e in self.envs:
             e.close()
+        for g, fn in getattr(self, "_gauge_fns", ()):
+            g.clear_fn(fn)
 
     def __enter__(self):
         return self
@@ -207,6 +256,7 @@ class Fuzzer:
         if fresh:
             self.max_signal.update(fresh)
             self.new_signal.update(fresh)
+            self._m_new_signal.inc(len(fresh))
 
     def _fold_batch_signal(self, batch_sigs) -> None:
         """Fold one device batch's executed signal into the max-signal
@@ -222,6 +272,7 @@ class Fuzzer:
         flat = [s for sigs in batch_sigs for s in sigs or ()]
         if not flat:
             return
+        t0 = time.perf_counter()
         nbits = self._max_bits.shape[0] * 32
         h = np.asarray(flat, dtype=np.uint64) & np.uint64(nbits - 1)
         words = (h >> np.uint64(5)).astype(np.int64)
@@ -234,6 +285,7 @@ class Fuzzer:
         self._max_bits[uw] |= m
         self.stats["device_new_bits"] = self.stats.get(
             "device_new_bits", 0) + count
+        self._h_signal_fold.observe(time.perf_counter() - t0)
 
     # ---- execution ----
 
@@ -255,6 +307,7 @@ class Fuzzer:
         _, infos, failed, hanged = env.exec(opts, p)
         self.stats["exec_total"] += 1
         self.stats[stat] = self.stats.get(stat, 0) + 1
+        self._m_exec_total.inc()
         if failed or hanged or not scan_new:
             return infos
         # check per-call signal for novelty -> triage
@@ -270,6 +323,10 @@ class Fuzzer:
     # ---- triage (reference triageInput fuzzer.go:521-625) ----
 
     def triage(self, item: TriageItem) -> None:
+        with timed("fuzzer.triage", self._h_triage):
+            self._triage(item)
+
+    def _triage(self, item: TriageItem) -> None:
         opts = ExecOpts(collect_signal=True, collect_cover=True)
         inter: Optional[Set[int]] = None
         cover: Set[int] = set()
@@ -303,6 +360,7 @@ class Fuzzer:
         if not self._add_corpus(item.prog, sig_list):
             return  # minimized to an already-known program
         self.stats["new_inputs"] += 1
+        self._m_new_inputs.inc()
         self.manager.new_input(serialize(item.prog), item.call_index,
                                sig_list, sorted(cover))
         self.queue.push_smash(SmashItem(item.prog, item.call_index))
@@ -332,6 +390,10 @@ class Fuzzer:
     # ---- smash (reference smashInput fuzzer.go:491-519) ----
 
     def smash(self, item: SmashItem) -> None:
+        with timed("fuzzer.smash", self._h_smash):
+            self._smash(item)
+
+    def _smash(self, item: SmashItem) -> None:
         if self.cfg.collect_comps:
             self._hints_seed(item)
         if self.cfg.fault_injection and item.call_index >= 0:
@@ -414,6 +476,10 @@ class Fuzzer:
         signal is new and the program is worth triaging.  Fallback rows
         (sanitize-special calls / codec long tail) decode eagerly and take
         the regular execute() path."""
+        with timed("device.batch_exec", self._h_device_batch):
+            self._run_device_batch_inner(batch)
+
+    def _run_device_batch_inner(self, batch) -> None:
         opts = ExecOpts()
         batch_sigs = []
         for i in range(len(batch)):
@@ -441,6 +507,7 @@ class Fuzzer:
                 opts, stream, call_ids)
             self.stats["exec_total"] += 1
             self.stats["exec_fuzz"] = self.stats.get("exec_fuzz", 0) + 1
+            self._m_exec_total.inc()
             if failed or hanged:
                 continue
             decoded = None
@@ -476,6 +543,8 @@ class Fuzzer:
                 if len(batch):
                     self.stats["device_batches"] += 1
                     self.stats["device_candidates"] += len(batch)
+                    self._m_device_batches.inc()
+                    self._m_device_candidates.inc(len(batch))
                     self._run_device_batch(batch)
                     return
                 # fully-stale batch: fall through to regular queue work
@@ -490,8 +559,11 @@ class Fuzzer:
             self.smash(item)
             return
         if not self.corpus or self._iter % self.cfg.generate_period == 0:
-            p = generate(self.target, self.rng, self.cfg.program_length,
-                         self.choice_table)
+            # only the host generation is timed: the execute() round trip
+            # is already measured by ipc_exec_latency_seconds
+            with timed("fuzzer.generate", self._h_generate):
+                p = generate(self.target, self.rng, self.cfg.program_length,
+                             self.choice_table)
             self.execute(p, "exec_gen")
         else:
             p = self.corpus[self.rng.intn(len(self.corpus))].clone()

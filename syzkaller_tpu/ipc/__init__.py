@@ -20,13 +20,21 @@ import struct
 import subprocess
 import tempfile
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
 from ..prog.encodingexec import serialize_for_exec
 from ..prog.prog import Prog
+from ..telemetry import get_registry
 from . import protocol as P
 from .build import build_executor
+
+
+def _exec_histogram():
+    return get_registry().histogram(
+        "ipc_exec_latency_seconds",
+        help="wall time of one executor round trip (exec_raw)")
 
 _REQ = struct.Struct("<6Q")
 _REPLY = struct.Struct("<3Q")
@@ -136,6 +144,7 @@ class Env:
         self._out_mm = mmap.mmap(self._out_f.fileno(), P.OUT_SHM_SIZE)
         self._proc: Optional[subprocess.Popen] = None
         self.restarts = 0
+        self._h_exec = _exec_histogram()
 
     # ---- process lifecycle ----
 
@@ -240,6 +249,7 @@ class Env:
             # don't tear it down (distinct from the crash path below)
             return b"", [], True, False
         failed = hanged = False
+        t0 = time.perf_counter()
         try:
             self._ensure_proc()
             self._write_in(data)
@@ -251,6 +261,8 @@ class Env:
             # the next exec respawns it
             self._drain_proc()
             return b"", [], True, False
+        finally:
+            self._h_exec.observe(time.perf_counter() - t0)
         if status == P.STATUS_FAILED:
             failed = True
         elif status == P.STATUS_HANGED:
@@ -328,6 +340,7 @@ class MockEnv:
         self.pid = pid
         self.signal_space = signal_space
         self.restarts = 0
+        self._h_exec = _exec_histogram()
 
     def close(self) -> None:
         pass
@@ -367,6 +380,7 @@ class MockEnv:
         Pointer-valued consts (>= data_offset) fingerprint as pointers."""
         from ..prog.encodingexec import decode_exec
 
+        t0 = time.perf_counter()
         data_off = getattr(self.target, "data_offset", 512 << 20)
         infos: List[CallInfo] = []
         i = 0
@@ -400,6 +414,7 @@ class MockEnv:
                 cover=sig if opts.collect_cover else [],
                 comps=comps if opts.collect_comps else []))
             i += 1
+        self._h_exec.observe(time.perf_counter() - t0)
         return b"", infos, False, False
 
 

@@ -16,12 +16,14 @@ import os
 import random
 import threading
 import time
+import weakref
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Set
 
 from ..db import DB
 from ..prog.encoding import deserialize, serialize
 from ..prog.prio import calculate_priorities
+from ..telemetry import get_registry, timed
 from ..utils.hash import hash_str
 from ..vm import VMConfig
 from .rpc import RpcServer
@@ -84,7 +86,45 @@ class Manager:
         self._db_lock = threading.Lock()
         self.phase = PHASE_INIT
         self.start_time = time.time()
-        self.stats: Dict[str, int] = {}  # the manager's own counters
+        # the manager's counters dual-write: _stats_local keeps the
+        # historic per-manager RPC/snapshot dict shape (several managers
+        # can share one process, e.g. the hub federation tests), and the
+        # process-wide telemetry registry carries the same bumps for
+        # /metrics exposition
+        self.metrics = get_registry()
+        self._stats_local: Dict[str, int] = {}
+        self._counters: Dict[str, object] = {}  # bind-once, see _counter
+        self._h_hub_sync = self.metrics.histogram(
+            "hub_sync_seconds", help="wall time of one hub delta exchange")
+        self.metrics.counter("exec_total", help="programs executed")
+        self.metrics.histogram(
+            "device_batch_latency_seconds",
+            help="wall time to execute one device candidate batch")
+        # gauges are weakref-bound and detached in close(): the registry
+        # outlives manager instances (several share one process in the
+        # hub federation tests) and must not pin a dead one's corpus
+        ref = weakref.ref(self)
+
+        def _live(attr):
+            return lambda: (len(getattr(s, attr))
+                            if (s := ref()) is not None else 0)
+
+        self._gauge_fns = [
+            (self.metrics.gauge("corpus_size",
+                                help="programs in the manager corpus"),
+             _live("corpus")),
+            (self.metrics.gauge("max_signal_size",
+                                help="accumulated max-signal PCs"),
+             _live("max_signal")),
+            (self.metrics.gauge("connected_fuzzers",
+                                help="fuzzers connected over RPC"),
+             _live("connected_fuzzers")),
+            (self.metrics.gauge("pending_candidates",
+                                help="candidates waiting for triage"),
+             _live("candidates")),
+        ]
+        for g, fn in self._gauge_fns:
+            g.set_fn(fn)
         # absolute per-fuzzer counter snapshots (summed for reporting);
         # a single shared dict would flip-flop between fuzzers' values
         self._fuzzer_stats: Dict[str, Dict[str, int]] = {}
@@ -252,10 +292,24 @@ class Manager:
 
     def on_poll(self, name: str, stats: Dict[str, int],
                 need_candidates: bool, new_signal: Sequence[int]):
+        fleet_deltas: Dict[str, int] = {}
         with self._lock:
             if stats:
-                self._fuzzer_stats[name] = {
-                    k: int(v) for k, v in stats.items()}
+                snap = {k: int(v) for k, v in stats.items()}
+                prev = self._fuzzer_stats.get(name, {})
+                # fleet_-prefixed registry counters carry remote fuzzers'
+                # absolute snapshots as deltas, so /metrics covers the
+                # RPC topology too; the bare names stay reserved for
+                # in-process fuzzers (which write the registry directly —
+                # a shared name would double-count them).  v < prev means
+                # the fuzzer restarted and its counters reset: the whole
+                # post-restart value is the delta
+                fleet_deltas = {}
+                for k, v in snap.items():
+                    dv = v - prev.get(k, 0) if v >= prev.get(k, 0) else v
+                    if dv > 0:
+                        fleet_deltas[k] = dv
+                self._fuzzer_stats[name] = snap
             self._note_signal(new_signal)
             cur = self._signal_cursor.get(name, 0)
             delta = self._signal_log[cur:]
@@ -270,15 +324,34 @@ class Manager:
                 if had and not self.candidates and \
                         self.phase == PHASE_LOADED_CORPUS:
                     self.phase = PHASE_TRIAGED_CORPUS
+        for k, dv in fleet_deltas.items():
+            self._counter("fleet_" + k).inc(dv)
         return {
             "new_inputs": inputs,
             "candidates": cands,
             "max_signal": delta,
         }
 
+    def _counter(self, name: str):
+        """Bind-once counter cache: _bump and the fleet-delta path must
+        pay one locked add per call, not a registry get-or-create."""
+        c = self._counters.get(name)
+        if c is None:
+            c = self._counters[name] = self.metrics.counter(name)
+        return c
+
     def _bump(self, stat: str, n: int = 1) -> None:
         with self._lock:
-            self.stats[stat] = self.stats.get(stat, 0) + n
+            self._stats_local[stat] = self._stats_local.get(stat, 0) + n
+        self._counter(stat).inc(n)
+
+    @property
+    def stats(self) -> Dict[str, int]:
+        """This manager's own counters in the historic dict shape
+        (RPC/snapshot/tests consume this); the registry carries the
+        process-wide totals for /metrics."""
+        with self._lock:
+            return dict(self._stats_local)
 
     # ---- crash persistence (reference saveCrash manager.go:570-640) ----
 
@@ -371,6 +444,7 @@ class Manager:
     # ---- stats / bench ----
 
     def snapshot(self) -> Dict[str, object]:
+        stats = self.stats  # registry-backed; takes its own locks
         with self._lock:
             fleet: Dict[str, int] = {}
             for per in self._fuzzer_stats.values():
@@ -386,7 +460,7 @@ class Manager:
                 "crashes": sum(e.count for e in self.crashes.values()),
                 "crash_types": len(self.crashes),
                 **fleet,
-                **self.stats,
+                **stats,
             }
 
     # ---- hub sync (reference manager.go:994-...; syz-hub/hub.go) ----
@@ -396,6 +470,10 @@ class Manager:
         the same call, like the reference's while-More loop); received
         programs are injected as candidates.  Returns number of programs
         received.  Runs from the hub thread; callable directly in tests."""
+        with timed("manager.hub_sync", self._h_hub_sync):
+            return self._hub_sync_once()
+
+    def _hub_sync_once(self) -> int:
         from ..hub import HubClient
 
         if self._hub is None:
@@ -474,6 +552,8 @@ class Manager:
 
     def close(self) -> None:
         self._stop.set()
+        for g, fn in getattr(self, "_gauge_fns", ()):
+            g.clear_fn(fn)
         self.rpc.stop()
         if self.http is not None:
             self.http.stop()

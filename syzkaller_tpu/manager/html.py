@@ -19,6 +19,7 @@ import urllib.parse
 from typing import Dict, List, Optional
 
 from ..prog.encoding import call_set
+from ..telemetry import get_registry, get_tracer
 
 _STYLE = """
 <style>
@@ -76,6 +77,8 @@ class ManagerHttp:
                         "/rawcover": ui._rawcover,
                         "/prio": ui._prio,
                         "/stats": ui._stats,
+                        "/metrics": ui._metrics,
+                        "/trace": ui._trace,
                     }.get(url.path)
                     if route is None:
                         self.send_error(404)
@@ -125,7 +128,9 @@ class ManagerHttp:
         body = (
             f'<p><a href="/corpus">corpus</a> | <a href="/cover">cover</a>'
             f' | <a href="/prio">prio</a> | <a href="/rawcover">rawcover</a>'
-            f' | <a href="/stats">stats.json</a></p>'
+            f' | <a href="/stats">stats.json</a>'
+            f' | <a href="/metrics">metrics</a>'
+            f' | <a href="/trace">trace</a></p>'
             + "<h2>stats</h2>" + _table(["stat", "value"], stats_rows)
             + "<h2>crashes</h2>"
             + _table(["title", "count"], crash_rows, raw=True))
@@ -243,3 +248,17 @@ class ManagerHttp:
     def _stats(self, q) -> tuple:
         return ("application/json",
                 json.dumps(self.mgr.snapshot(), sort_keys=True).encode())
+
+    # ---- telemetry (ISSUE 1: registry + tracer exposition) ----
+
+    def _metrics(self, q) -> tuple:
+        """Prometheus text exposition of the process-wide registry (the
+        manager's counters plus any in-process fuzzers' latencies)."""
+        return ("text/plain; version=0.0.4",
+                get_registry().prometheus_text().encode())
+
+    def _trace(self, q) -> tuple:
+        """Chrome trace-event JSON of the span buffer — load the response
+        in chrome://tracing or Perfetto to read per-phase wall time."""
+        return ("application/json",
+                json.dumps(get_tracer().chrome_trace()).encode())

@@ -170,13 +170,26 @@ def minimize_corpus(program_bits, sizes=None):
     Dispatches to the pallas kernel (ops/pallas_cover.py) on TPU when the
     bitset fits VMEM; this function is the exact XLA-scan semantics both
     share.  Call _minimize_corpus_xla directly from inside jit (the pallas
-    wrapper is eager)."""
+    wrapper is eager).  The eager entry is span-timed (``cover.minimize``)
+    — corpus minimization is a triage-ladder phase the manager graphs."""
     if not isinstance(program_bits, jax.core.Tracer):
         from . import pallas_cover
+        from ..telemetry import get_tracer
 
         pb = jnp.asarray(program_bits, U32)
-        if pallas_cover._use_pallas(pb.shape[-1], pb.shape[0]):
-            return pallas_cover._minimize_pallas_entry(pb, sizes)
+        # block inside the span (jax dispatch is async; an enqueue-only
+        # timing reads near-zero regardless of corpus size) — but only
+        # when spans are on: the barrier is the span's cost, not the
+        # caller's
+        tracer = get_tracer()
+        with tracer.span("cover.minimize"):
+            if pallas_cover._use_pallas(pb.shape[-1], pb.shape[0]):
+                out = pallas_cover._minimize_pallas_entry(pb, sizes)
+            else:
+                out = _minimize_corpus_xla(program_bits, sizes)
+            if tracer.enabled:
+                jax.block_until_ready(out)
+        return out
     return _minimize_corpus_xla(program_bits, sizes)
 
 

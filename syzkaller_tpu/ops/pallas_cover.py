@@ -32,6 +32,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import ensure_x64  # noqa: F401
+from ..utils.jaxcfg import x64_context
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -98,7 +99,7 @@ def _minimize_pallas(tiles):
     n, r, _ = tiles.shape
     # the kernels are strictly 32-bit; trace them with x64 off, since the
     # mosaic lowering rejects the weak-int64 scalars x64 mode introduces
-    with jax.enable_x64(False):
+    with x64_context(False):
         return pl.pallas_call(
         _minimize_kernel,
         grid=(n,),
@@ -160,7 +161,7 @@ def _stats_kernel(acc_ref, bits_ref, count_ref, merged_ref):
 
 def _stats_pallas(acc_tiles, tiles):
     n, r, _ = tiles.shape
-    with jax.enable_x64(False):
+    with x64_context(False):
         counts, merged = pl.pallas_call(
         _stats_kernel,
         grid=(n,),

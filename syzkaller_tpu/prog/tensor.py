@@ -126,24 +126,66 @@ def template_count(t: ArrayType) -> int:
     return 1
 
 
-def template_arg(t) -> Arg:
-    """Default arg tree with exactly the template's shape."""
-    if isinstance(t, PtrType):
-        return PointerArg(t, 0, 0, 0, template_arg(t.elem))
-    if isinstance(t, VmaType):
-        return PointerArg(t, 0, 0, max(1, t.range_begin), None)
-    if isinstance(t, ArrayType):
-        return GroupArg(t, [template_arg(t.elem)
-                            for _ in range(template_count(t))])
-    if isinstance(t, StructType):
-        return GroupArg(t, [template_arg(f) for f in t.fields])
-    if isinstance(t, UnionType):
-        return UnionArg(t, template_arg(t.fields[0]), t.fields[0])
-    if isinstance(t, BufferType):
-        return DataArg(t, b"")
-    if isinstance(t, ResourceType):
-        return make_result_arg(t, None, t.default())
-    return default_arg(t)
+def template_arg(t, _budget: Optional[List[int]] = None) -> Arg:
+    """Default arg tree with exactly the template's shape.
+
+    Iterative (explicit work stack) and slot-budgeted: self-referential
+    types (linked lists etc.) would otherwise expand forever.  Mirrors
+    descriptions/tables.flatten, which stops emitting slots at
+    MAX_SLOTS_PER_CALL — once the budget is spent, pointer expansion is
+    pruned (res=None, the canonical &nil).  Cycles always pass through a
+    pointer, and every pointer consumes a budget unit before its pointee
+    expands, so the tree is finite; the non-pointer shape below a cut is
+    still built in full so decoded programs keep valid struct/union/array
+    arity.  Budget accounting runs in the same DFS preorder as flatten
+    and walk_slots, so the walked slot kinds stay pinned to the tables."""
+    budget = _budget if _budget is not None else [MAX_SLOTS_PER_CALL]
+    out: List[Arg] = []
+    # stack of (type, put) where put() places the constructed Arg into its
+    # parent; children are pushed reversed so pops run left-to-right
+    stack: List[Tuple[object, object]] = [(t, out.append)]
+    while stack:
+        typ, put = stack.pop()
+        if isinstance(typ, PtrType):
+            arg = PointerArg(typ, 0, 0, 0, None)
+            put(arg)
+            budget[0] -= 1
+            if budget[0] > 0:
+                def _set_res(a, _p=arg):
+                    _p.res = a
+
+                stack.append((typ.elem, _set_res))
+        elif isinstance(typ, VmaType):
+            budget[0] -= 1
+            put(PointerArg(typ, 0, 0, max(1, typ.range_begin), None))
+        elif isinstance(typ, ArrayType):
+            g = GroupArg(typ, [])
+            put(g)
+            stack.extend((typ.elem, g.inner.append)
+                         for _ in range(template_count(typ)))
+        elif isinstance(typ, StructType):
+            g = GroupArg(typ, [])
+            put(g)
+            stack.extend((f, g.inner.append) for f in reversed(typ.fields))
+        elif isinstance(typ, UnionType):
+            u = UnionArg(typ, None, typ.fields[0])
+            put(u)
+
+            def _set_opt(a, _u=u):
+                _u.option = a
+
+            stack.append((typ.fields[0], _set_opt))
+        elif isinstance(typ, BufferType):
+            budget[0] -= 1
+            put(DataArg(typ, b""))
+        elif isinstance(typ, ResourceType):
+            budget[0] -= 1
+            put(make_result_arg(typ, None, typ.default()))
+        else:
+            if not (isinstance(typ, ConstType) and is_pad(typ)):
+                budget[0] -= 1
+            put(default_arg(typ))
+    return out[0]
 
 
 def walk_slots(args: List[Arg], budget: Optional[List[int]] = None
@@ -199,18 +241,22 @@ def walk_slots(args: List[Arg], budget: Optional[List[int]] = None
 
 def _zip_template(meta, actual_args: List[Arg]) -> List[Arg]:
     """Build a template-shaped tree taking values from the actual tree where
-    shapes align (lossy projection of a host program onto the template)."""
+    shapes align (lossy projection of a host program onto the template).
+    Slot-budgeted like template_arg: pointer expansion is pruned once the
+    per-arg budget is spent, so self-referential types terminate."""
+    budget = [MAX_SLOTS_PER_CALL]
 
     def proj(t, a: Optional[Arg]) -> Arg:
-        if a is None or a.typ.__class__ is not t.__class__ \
-                and not isinstance(t, (StructType, UnionType, ArrayType)):
-            pass
         if isinstance(t, PtrType):
+            budget[0] -= 1
+            if budget[0] <= 0:
+                return PointerArg(t, 0, 0, 0, None)
             res = None
             if isinstance(a, PointerArg):
                 res = a.res
             return PointerArg(t, 0, 0, 0, proj(t.elem, res))
         if isinstance(t, VmaType):
+            budget[0] -= 1
             npg = a.pages_num if isinstance(a, PointerArg) and a.pages_num \
                 else max(1, t.range_begin)
             return PointerArg(t, 0, 0, npg, None)
@@ -233,9 +279,11 @@ def _zip_template(meta, actual_args: List[Arg]) -> List[Arg]:
                 return UnionArg(t, proj(opt0, a.option), opt0)
             return UnionArg(t, proj(opt0, None), opt0)
         if isinstance(t, BufferType):
+            budget[0] -= 1
             data = a.data if isinstance(a, DataArg) else b""
             return DataArg(t, data)
         if isinstance(t, ResourceType):
+            budget[0] -= 1
             if isinstance(a, ResultArg):
                 na = ResultArg(t, res=a.res, val=a.val, op_div=a.op_div,
                                op_add=a.op_add)
@@ -243,9 +291,11 @@ def _zip_template(meta, actual_args: List[Arg]) -> List[Arg]:
             return ResultArg(t, None, t.default())
         if isinstance(t, (IntType, FlagsType, ProcType, LenType, CsumType,
                           ConstType)):
+            if not (isinstance(t, ConstType) and is_pad(t)):
+                budget[0] -= 1
             val = a.val if isinstance(a, ConstArg) else t.default()
             return ConstArg(t, val)
-        return template_arg(t)
+        return template_arg(t, budget)
 
     return [proj(t, actual_args[i] if i < len(actual_args) else None)
             for i, t in enumerate(meta.args)]

@@ -1,0 +1,56 @@
+"""Telemetry subsystem: metrics registry + span tracing.
+
+One import surface for the rest of the tree:
+
+    from ..telemetry import get_registry, span
+
+    get_registry().counter("exec_total").inc()
+    with span("device.fuzz_step"):
+        ...
+
+``metrics`` holds the thread-safe counter/gauge/histogram registry with
+snapshot()/delta() and Prometheus text exposition; ``trace`` holds the
+nestable span timers with Chrome-trace JSON export.  The manager serves
+both on /metrics and /trace (manager/html.py); ``--telemetry-out`` on the
+engine and bench.py dumps them as one JSON document.
+
+No jax/numpy imports here: telemetry must load (and stay cheap) on
+host-only deployments.
+"""
+
+from .metrics import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    get_registry,
+)
+from .trace import Tracer, get_tracer, span, timed  # noqa: F401
+
+
+def telemetry_dump() -> dict:
+    """The --telemetry-out document: metrics snapshot + Chrome trace."""
+    return {
+        "metrics": get_registry().snapshot(),
+        "trace": get_tracer().chrome_trace(),
+    }
+
+
+def telemetry_dump_to(path: str):
+    """Write the --telemetry-out document to ``path``.  Returns an error
+    string instead of raising — a bad dump path must not cost the caller
+    (engine CLI, bench) the run's own outcome."""
+    import json
+
+    try:
+        with open(path, "w") as fh:
+            json.dump(telemetry_dump(), fh)
+        return None
+    except Exception as e:
+        return f"{type(e).__name__}: {e}"
+
+
+def set_spans_enabled(enabled: bool) -> None:
+    """Global span opt-out (counters stay on — they are the wire stats)."""
+    get_registry().spans_enabled = bool(enabled)

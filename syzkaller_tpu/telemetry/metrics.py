@@ -1,0 +1,270 @@
+"""Thread-safe metrics registry: counters, gauges, fixed-bucket histograms.
+
+The observability spine of the engine (role parity with the reference
+syz-manager's Stat/uptime machinery plus its /metrics-style exposition):
+every hot path — engine triage/smash/generate, the device candidate
+pipeline, ipc exec, manager RPC, hub sync — bumps metrics here, and the
+manager HTTP UI serves the registry as Prometheus text on ``/metrics``.
+
+Design constraints (BASELINE: this rides the 100x-triage hot path):
+  - counters are plain locked integer adds — no labels, no allocation;
+  - histograms are fixed-bucket (bisect + locked add), latency-oriented;
+  - gauges may be callback-backed (``set_fn``) so registry reads always
+    see live state (corpus size etc.) without per-update bookkeeping;
+  - ``snapshot()`` returns a flat name->number dict and ``delta()`` diffs
+    two snapshots, so BENCH rounds can report per-phase rates;
+  - the registry carries the ``spans_enabled`` flag that telemetry.trace
+    consults — spans are opt-out with one attribute write.
+
+Everything in-process shares the module-default registry (``get_registry``)
+so a manager plus in-process fuzzers expose one merged view; tests build
+private ``Registry()`` instances or ``reset()`` the default.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+Number = Union[int, float]
+
+# Latency-oriented defaults: 100us .. 10s, roughly log-spaced.  Device
+# dispatch lands in the low buckets, first-call JIT compiles in the top.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class Counter:
+    """Monotonic counter; ``inc`` is a plain locked integer add."""
+
+    __slots__ = ("name", "help", "_lock", "_value")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._value = 0
+
+    def inc(self, n: Number = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> Number:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Point-in-time value; either set explicitly or backed by a callback
+    (``set_fn``) that is evaluated on every read."""
+
+    __slots__ = ("name", "help", "_lock", "_value", "_fn")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._value: Number = 0
+        self._fn: Optional[Callable[[], Number]] = None
+
+    def set(self, v: Number) -> None:
+        with self._lock:
+            self._value = v
+            self._fn = None
+
+    def inc(self, n: Number = 1) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: Number = 1) -> None:
+        self.inc(-n)
+
+    def set_fn(self, fn: Optional[Callable[[], Number]]) -> None:
+        with self._lock:
+            self._fn = fn
+
+    def clear_fn(self, fn: Callable[[], Number]) -> None:
+        """Detach ``fn`` iff it is still the bound callback — a newer
+        instance may have re-bound the gauge, and its callback must not
+        be clobbered by an older instance's close()."""
+        with self._lock:
+            if self._fn is fn:
+                self._fn = None
+
+    @property
+    def value(self) -> Number:
+        with self._lock:
+            fn = self._fn
+            if fn is None:
+                return self._value
+        try:
+            return fn()
+        except Exception:  # callback raced a teardown: last value stands
+            return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram (cumulative on exposition, like Prometheus).
+
+    ``observe`` is a bisect over a small static tuple plus one locked
+    add — cheap enough for per-exec latencies."""
+
+    __slots__ = ("name", "help", "buckets", "_lock", "_counts", "_sum",
+                 "_count")
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help
+        self.buckets = tuple(sorted(buckets))
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.buckets) + 1)  # last = +Inf
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, v: Number) -> None:
+        i = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def cumulative(self) -> List[Tuple[float, int]]:
+        """[(le, cumulative_count), ...] ending with (+inf, total)."""
+        with self._lock:
+            counts = list(self._counts)
+        out: List[Tuple[float, int]] = []
+        run = 0
+        for le, c in zip(self.buckets, counts):
+            run += c
+            out.append((le, run))
+        out.append((float("inf"), run + counts[-1]))
+        return out
+
+
+class Registry:
+    """Name -> metric map with get-or-create accessors.
+
+    ``spans_enabled`` is the opt-out flag telemetry.trace checks before
+    recording span events (counters stay on: they are the wire stats)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+        self.spans_enabled = True
+        # bumped on reset() so holders of bound metric objects (the
+        # tracer's histogram cache) can detect staleness cheaply
+        self.generation = 0
+
+    def _get_or_create(self, name: str, cls, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, **kw)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(m).__name__}, not {cls.__name__}")
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(name, Counter, help=help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(name, Gauge, help=help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(name, Histogram, help=help,
+                                   buckets=buckets)
+
+    def get(self, name: str):
+        with self._lock:
+            return self._metrics.get(name)
+
+    def metrics(self) -> List[object]:
+        with self._lock:
+            return [self._metrics[k] for k in sorted(self._metrics)]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+            self.spans_enabled = True
+            self.generation += 1
+
+    # ---- snapshots ----
+
+    def snapshot(self) -> Dict[str, Number]:
+        """Flat name->number view: counters and gauges by name, histograms
+        as ``<name>_count`` / ``<name>_sum``."""
+        out: Dict[str, Number] = {}
+        for m in self.metrics():
+            if isinstance(m, Counter):
+                out[m.name] = m.value
+            elif isinstance(m, Gauge):
+                out[m.name] = m.value
+            elif isinstance(m, Histogram):
+                out[m.name + "_count"] = m.count
+                out[m.name + "_sum"] = round(m.sum, 9)
+        return out
+
+    def delta(self, prev: Dict[str, Number]) -> Dict[str, Number]:
+        """Difference of the current snapshot against an earlier one
+        (names absent from ``prev`` diff against 0); gauge values pass
+        through as-is since rates over gauges are meaningless."""
+        cur = self.snapshot()
+        gauges = {m.name for m in self.metrics() if isinstance(m, Gauge)}
+        return {k: v if k in gauges else v - prev.get(k, 0)
+                for k, v in cur.items()}
+
+    # ---- Prometheus text exposition (format 0.0.4) ----
+
+    def prometheus_text(self) -> str:
+        lines: List[str] = []
+        for m in self.metrics():
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            if isinstance(m, Counter):
+                lines.append(f"# TYPE {m.name} counter")
+                lines.append(f"{m.name} {_fmt(m.value)}")
+            elif isinstance(m, Gauge):
+                lines.append(f"# TYPE {m.name} gauge")
+                lines.append(f"{m.name} {_fmt(m.value)}")
+            elif isinstance(m, Histogram):
+                lines.append(f"# TYPE {m.name} histogram")
+                for le, c in m.cumulative():
+                    le_s = "+Inf" if le == float("inf") else _fmt(le)
+                    lines.append(
+                        f'{m.name}_bucket{{le="{le_s}"}} {c}')
+                lines.append(f"{m.name}_sum {_fmt(m.sum)}")
+                lines.append(f"{m.name}_count {m.count}")
+        return "\n".join(lines) + "\n"
+
+
+def _fmt(v: Number) -> str:
+    if isinstance(v, float):
+        return repr(v) if v != int(v) else str(int(v))
+    return str(v)
+
+
+_default = Registry()
+
+
+def get_registry() -> Registry:
+    """The process-wide default registry (manager + in-process fuzzers
+    share it so /metrics exposes one merged view)."""
+    return _default

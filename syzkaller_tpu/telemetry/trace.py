@@ -1,0 +1,209 @@
+"""Nestable span timers with Chrome-trace JSON export.
+
+``with span("device.fuzz_step"): ...`` records one complete event (wall
+time, thread, nesting depth) into a bounded in-process ring buffer.  The
+manager UI serves the buffer as Chrome trace-event JSON on ``/trace``
+(load it in chrome://tracing or Perfetto); ``--telemetry-out`` dumps the
+same document next to the metrics snapshot.
+
+Spans are opt-out via the metrics registry flag (``spans_enabled``) — when
+off, ``span()`` returns a shared no-op context manager, so the hot path
+pays one attribute read.  Each finished ``span()`` feeds a latency
+histogram named ``span_<name>_seconds`` (dots -> underscores) in the
+registry, which is how per-phase breakdowns reach /metrics and BENCH.
+Hot paths that own a canonical histogram use ``timed(name, hist)``
+instead: one clock-read pair feeding the explicit histogram (always —
+latency metrics are wire stats) plus a trace event when spans are on.
+
+Device-kernel convention: the first invocation of a jitted step traces and
+compiles inside the call, so the caller records it under
+``<name>.compile`` and steady-state invocations under ``<name>.dispatch``
+(see parallel/mesh.make_fuzz_step) — the Chrome trace then separates
+first-call JIT time from dispatch without any XLA introspection.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+from . import metrics as _metrics
+
+MAX_EVENTS = 65536  # ring-bounded: a week-long run must not eat the heap
+
+
+class _NullSpan:
+    """Shared no-op context manager for the spans-disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _HistTimer:
+    """Times into an explicit histogram only — the spans-disabled arm of
+    ``Tracer.timed``: latency metrics are wire stats and stay on."""
+
+    __slots__ = ("hist", "_t0")
+
+    def __init__(self, hist):
+        self.hist = hist
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.hist.observe(time.perf_counter() - self._t0)
+        return False
+
+
+class _Span:
+    __slots__ = ("tracer", "name", "hist", "_t0")
+
+    def __init__(self, tracer: "Tracer", name: str, hist=None):
+        self.tracer = tracer
+        self.name = name
+        self.hist = hist
+
+    def __enter__(self):
+        tls = self.tracer._tls
+        stack = getattr(tls, "stack", None)
+        if stack is None:
+            stack = tls.stack = []
+        stack.append(self)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        tls_stack = self.tracer._tls.stack
+        # tolerate exits out of order after an exception unwound the stack
+        while tls_stack and tls_stack[-1] is not self:
+            tls_stack.pop()
+        depth = max(len(tls_stack) - 1, 0)
+        if tls_stack:
+            tls_stack.pop()
+        self.tracer._record(self.name, self._t0, t1, depth, self.hist)
+        return False
+
+
+class Tracer:
+    """Bounded buffer of finished spans + Chrome-trace export."""
+
+    def __init__(self, registry: Optional[_metrics.Registry] = None,
+                 max_events: int = MAX_EVENTS):
+        self.registry = registry
+        self._events: deque = deque(maxlen=max_events)
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self._epoch = time.perf_counter()
+        self._hists: Dict[str, _metrics.Histogram] = {}
+        self._hists_gen = -1  # registry generation the cache belongs to
+
+    def _reg(self) -> _metrics.Registry:
+        return self.registry or _metrics.get_registry()
+
+    @property
+    def enabled(self) -> bool:
+        return self._reg().spans_enabled
+
+    def span(self, name: str):
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self, name)
+
+    def timed(self, name: str, hist: _metrics.Histogram):
+        """One timing, two sinks: the explicit histogram always gets the
+        observation (latency metrics are wire stats), and a trace event
+        is recorded when spans are enabled.  The instrumented hot paths
+        use this instead of a manual perf_counter pair around a span —
+        one clock read pair and one observe per phase."""
+        if not self.enabled:
+            return _HistTimer(hist)
+        return _Span(self, name, hist)
+
+    def _record(self, name: str, t0: float, t1: float, depth: int,
+                hist: Optional[_metrics.Histogram] = None) -> None:
+        with self._lock:
+            self._events.append(
+                (name, t0 - self._epoch, t1 - t0, threading.get_ident(),
+                 depth))
+        if hist is not None:  # timed(): the caller owns the histogram
+            hist.observe(t1 - t0)
+            return
+        reg = self._reg()
+        with self._lock:
+            if self._hists_gen != reg.generation:
+                # registry was reset: cached histograms are orphans that
+                # no longer reach /metrics — drop and re-create
+                self._hists.clear()
+                self._hists_gen = reg.generation
+            h = self._hists.get(name)
+        if h is None:
+            h = reg.histogram(
+                "span_" + name.replace(".", "_").replace("-", "_")
+                + "_seconds",
+                help=f"wall time of span {name}")
+            with self._lock:
+                self._hists[name] = h
+        h.observe(t1 - t0)
+
+    def events(self) -> List[tuple]:
+        with self._lock:
+            return list(self._events)
+
+    def span_names(self) -> List[str]:
+        return sorted({e[0] for e in self.events()})
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._hists.clear()
+            self._epoch = time.perf_counter()
+
+    def chrome_trace(self) -> dict:
+        """Chrome trace-event document (complete 'X' events, microsecond
+        timestamps; args carry the nesting depth)."""
+        pid = os.getpid()
+        events = [{
+            "name": name,
+            "ph": "X",
+            "ts": round(ts * 1e6, 3),
+            "dur": round(dur * 1e6, 3),
+            "pid": pid,
+            "tid": tid,
+            "args": {"depth": depth},
+        } for name, ts, dur, tid, depth in self.events()]
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+_default = Tracer()
+
+
+def get_tracer() -> Tracer:
+    """The process-wide default tracer (pairs with metrics.get_registry)."""
+    return _default
+
+
+def span(name: str):
+    """``with span("phase"): ...`` on the default tracer — the one-liner
+    the hot paths use."""
+    return _default.span(name)
+
+
+def timed(name: str, hist: _metrics.Histogram):
+    """``with timed("phase", hist): ...`` on the default tracer — one
+    timing feeding the explicit histogram (always) and the trace buffer
+    (when spans are enabled)."""
+    return _default.timed(name, hist)

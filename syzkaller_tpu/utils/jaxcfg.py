@@ -22,6 +22,22 @@ def ensure_x64() -> None:
     _done = True
 
 
+def x64_context(enabled: bool = True):
+    """Version-tolerant `with x64 on/off` scope.
+
+    Some jax releases expose ``jax.enable_x64`` as a context manager;
+    others keep it in ``jax.experimental``.  The pallas kernels trace with
+    x64 off (mosaic rejects the weak-int64 scalars x64 mode introduces)
+    while the rest of the device code runs with it on — every scoped
+    toggle must route through here."""
+    import jax
+
+    ctx = getattr(jax, "enable_x64", None)
+    if ctx is None:
+        from jax.experimental import enable_x64 as ctx
+    return ctx(enabled)
+
+
 def _enable_compile_cache(jax) -> None:
     """Persistent XLA compilation cache.
 

@@ -44,12 +44,11 @@ def test_or_all_reduce():
     n = m.devices.shape[0]
     x = jnp.arange(n * 4, dtype=jnp.uint32).reshape(n, 4)
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(pmesh.shard_map(
         lambda v: collective.or_all_reduce(v, pmesh.AXIS_FUZZ),
         mesh=m,
         in_specs=jax.sharding.PartitionSpec(pmesh.AXIS_FUZZ),
-        out_specs=jax.sharding.PartitionSpec(pmesh.AXIS_FUZZ),
-        check_vma=False))(x)
+        out_specs=jax.sharding.PartitionSpec(pmesh.AXIS_FUZZ)))(x)
     expect = np.bitwise_or.reduce(np.asarray(x).reshape(n, 1, 4), axis=0)
     np.testing.assert_array_equal(np.asarray(out)[:1], expect)
 
