@@ -84,6 +84,26 @@ def _median_rate(fn, reps: int = 5, min_seconds: float = 2.0):
     return statistics.median(rates)
 
 
+def span_deltas(reg, before):
+    """Per-phase ``span_*_seconds`` histogram deltas (``_sum``/``_count``)
+    of registry snapshot ``before`` vs now — the ROADMAP open item: each
+    BENCH config carries its compile vs dispatch vs transfer breakdown so
+    perf PRs are judged on where the time went, not just headline rates.
+    Zero deltas are dropped to keep the one-line JSON one line."""
+    return {k: v for k, v in telemetry_delta(reg, before).items()
+            if k.startswith("span_")}
+
+
+def telemetry_delta(reg, before):
+    """Whole-run registry snapshot delta for the JSON line's `telemetry`
+    key.  Exact-zero entries are dropped; gauges pass through as their
+    end-of-run point-in-time values (Registry.delta semantics), so
+    run-dependent gauge readings do appear and diff between rounds —
+    compare rounds on the counter/histogram ``_sum``/``_count`` keys."""
+    return {k: (round(v, 6) if isinstance(v, float) else v)
+            for k, v in reg.delta(before).items() if v}
+
+
 # ------------------------------------------------------------------ #
 # config[0]: mutation throughput
 
@@ -326,6 +346,7 @@ def main(argv=None):
     from syzkaller_tpu.ops.dtables import build_device_tables
     from syzkaller_tpu.prog import get_target
     from syzkaller_tpu.prog.tensor import TensorFormat
+    from syzkaller_tpu.telemetry import get_registry, span
 
     device = _ensure_backend()
     target = get_target("linux", "amd64")
@@ -333,44 +354,63 @@ def main(argv=None):
     fmt = TensorFormat.for_tables(tables, max_calls=16)
     dt = build_device_tables(tables, fmt)
 
+    reg = get_registry()
+    run_snap = reg.snapshot()
     configs = {}
 
-    dev_mut = bench_device_mutate(dt, C=fmt.max_calls)
-    host_mut = bench_host_mutate(target)
-    configs["mutate"] = {
-        "device": round(dev_mut, 1), "host": round(host_mut, 1),
-        "unit": "progs/sec"}
+    def run_config(name, fn):
+        """One benchmark config: the result dict plus the per-phase
+        span_*_seconds deltas it produced (each config body runs under a
+        bench.<name> span; the e2e config additionally emits the engine's
+        own compile/dispatch/triage spans)."""
+        before = reg.snapshot()
+        try:
+            with span(f"bench.{name}"):
+                configs[name] = fn()
+        except Exception as e:  # noqa: BLE001 — record, don't kill the line
+            configs[name] = {"error": str(e)[:200]}
+        configs[name]["spans"] = span_deltas(reg, before)
 
-    try:
+    dev_host = {}
+
+    def _mutate():
+        dev_host["dev_mut"] = bench_device_mutate(dt, C=fmt.max_calls)
+        dev_host["host_mut"] = bench_host_mutate(target)
+        return {"device": round(dev_host["dev_mut"], 1),
+                "host": round(dev_host["host_mut"], 1),
+                "unit": "progs/sec"}
+
+    run_config("mutate", _mutate)
+    if "error" in configs["mutate"]:
+        raise RuntimeError(
+            f"mutate (the headline config) failed: "
+            f"{configs['mutate']['error']}")
+    dev_mut, host_mut = dev_host["dev_mut"], dev_host["host_mut"]
+
+    def _cover():
         dev_cov, host_cov = bench_cover_merge()
-        configs["cover_merge_10k"] = {
-            "device": round(dev_cov, 1), "host": round(host_cov, 1),
-            "unit": "traces/sec"}
-    except Exception as e:  # noqa: BLE001 — record, don't kill the line
-        configs["cover_merge_10k"] = {"error": str(e)[:200]}
+        return {"device": round(dev_cov, 1), "host": round(host_cov, 1),
+                "unit": "traces/sec"}
 
-    try:
+    run_config("cover_merge_10k", _cover)
+
+    def _hints():
         dev_hint, host_hint = bench_hints()
-        configs["hints_100k"] = {
-            "device": round(dev_hint, 1), "host": round(host_hint, 1),
-            "unit": "site*comps/sec"}
-    except Exception as e:  # noqa: BLE001
-        configs["hints_100k"] = {"error": str(e)[:200]}
+        return {"device": round(dev_hint, 1), "host": round(host_hint, 1),
+                "unit": "site*comps/sec"}
 
-    try:
+    run_config("hints_100k", _hints)
+
+    def _e2e():
         e2e_dev, e2e_host, executor = bench_e2e(target)
-        configs["e2e_triage"] = {
-            "device_pipeline": round(e2e_dev, 1),
-            "host_only": round(e2e_host, 1),
-            "unit": "execs/sec", "executor": executor}
-    except Exception as e:  # noqa: BLE001
-        configs["e2e_triage"] = {"error": str(e)[:200]}
+        return {"device_pipeline": round(e2e_dev, 1),
+                "host_only": round(e2e_host, 1),
+                "unit": "execs/sec", "executor": executor}
 
-    try:
-        configs["hub_sync"] = {
-            "host": round(bench_hub(), 1), "unit": "progs/sec"}
-    except Exception as e:  # noqa: BLE001
-        configs["hub_sync"] = {"error": str(e)[:200]}
+    run_config("e2e_triage", _e2e)
+
+    run_config("hub_sync", lambda: {
+        "host": round(bench_hub(), 1), "unit": "progs/sec"})
 
     print(json.dumps({
         "metric": "mutation_throughput",
@@ -379,6 +419,7 @@ def main(argv=None):
         "vs_baseline": round(dev_mut / host_mut, 2),
         "device": device,
         "configs": configs,
+        "telemetry": telemetry_delta(reg, run_snap),
         "baseline_note": (
             "host = this repo's single-threaded Python reimplementation "
             "on one shared core, NOT the Go reference (no Go toolchain "

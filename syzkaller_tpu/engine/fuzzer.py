@@ -27,7 +27,14 @@ from typing import Dict, List, Optional, Sequence, Set
 
 from ..ipc import CallInfo, Env, EnvConfig, ExecOpts, MockEnv
 from ..prog.analysis import assign_sizes_call
-from ..telemetry import get_registry, timed
+from ..telemetry import (
+    Provenance,
+    get_ledger,
+    get_registry,
+    ops_from_mask,
+    timed,
+)
+from ..telemetry import attribution as _attr
 from ..prog.encoding import serialize
 from ..prog.generation import RandGen, generate
 from ..prog.hints import CompMap, mutate_with_hints
@@ -36,6 +43,18 @@ from ..prog.prio import build_choice_table
 from ..prog.prog import Prog
 from ..utils.hash import hash_str
 from .queue import CandidateItem, SmashItem, TriageItem, WorkQueue
+
+# exec-stat -> attribution phase (the stat strings are the RPC wire
+# vocabulary; the ledger speaks the ISSUE 2 phase vocabulary)
+_STAT_PHASE = {
+    "exec_gen": _attr.PHASE_GENERATE,
+    "exec_fuzz": _attr.PHASE_MUTATE,
+    "exec_smash": _attr.PHASE_SMASH,
+    "exec_hints": _attr.PHASE_HINTS,
+    "exec_candidate": _attr.PHASE_CANDIDATE,
+    "exec_triage": _attr.PHASE_TRIAGE,
+    "exec_minimize": _attr.PHASE_TRIAGE,
+}
 
 
 @dataclass
@@ -108,6 +127,8 @@ class Fuzzer:
         # locked add, not a registry lookup (ISSUE 1 overhead bound).
         reg = get_registry()
         self.metrics = reg
+        # phase/operator yield accounting (bound once — hot path)
+        self._ledger = get_ledger()
         self._m_exec_total = reg.counter(
             "exec_total", help="programs executed")
         self._m_new_inputs = reg.counter(
@@ -220,7 +241,11 @@ class Fuzzer:
             p = deserialize(self.target, text)
         except Exception:
             return
-        self._add_corpus(p, ())
+        if self._add_corpus(p, ()):
+            # connect-time corpus import: credited to the seed phase (no
+            # exec paid, no new_inputs bump — triaged work never lands
+            # here), so seed volume is auditable next to earned yield
+            self._ledger.record_corpus_add(_attr.PHASE_SEED)
 
     def _push_candidate_text(self, text: str) -> None:
         from ..prog.encoding import deserialize
@@ -251,12 +276,13 @@ class Fuzzer:
     def _signal_diff(self, sig: Sequence[int]) -> List[int]:
         return [s for s in sig if s not in self.max_signal]
 
-    def _note_signal(self, sig: Sequence[int]) -> None:
+    def _note_signal(self, sig: Sequence[int]) -> int:
         fresh = [s for s in sig if s not in self.max_signal]
         if fresh:
             self.max_signal.update(fresh)
             self.new_signal.update(fresh)
             self._m_new_signal.inc(len(fresh))
+        return len(fresh)
 
     def _fold_batch_signal(self, batch_sigs) -> None:
         """Fold one device batch's executed signal into the max-signal
@@ -291,10 +317,15 @@ class Fuzzer:
 
     def execute(self, p: Prog, stat: str = "exec_fuzz",
                 opts: Optional[ExecOpts] = None, pid: int = 0,
-                scan_new: bool = True) -> List[CallInfo]:
+                scan_new: bool = True,
+                origin: Optional[Provenance] = None) -> List[CallInfo]:
         """scan_new=False is the reference's executeRaw path
         (fuzzer.go:698): triage re-runs and minimize predicates must not
-        re-enqueue triage work for the program's other calls."""
+        re-enqueue triage work for the program's other calls.
+
+        ``origin`` is the program's provenance (phase + mutation operator
+        indices); it rides any TriageItems this execution enqueues so the
+        attribution ledger credits corpus yield to the producing phase."""
         opts = opts or ExecOpts()
         env = self.envs[pid % len(self.envs)]
         if self.cfg.log_programs:
@@ -308,6 +339,9 @@ class Fuzzer:
         self.stats["exec_total"] += 1
         self.stats[stat] = self.stats.get(stat, 0) + 1
         self._m_exec_total.inc()
+        if origin is None:
+            origin = Provenance(_STAT_PHASE.get(stat, stat))
+        self._ledger.record_exec(origin.phase, origin.ops)
         if failed or hanged or not scan_new:
             return infos
         # check per-call signal for novelty -> triage
@@ -317,7 +351,8 @@ class Fuzzer:
             diff = self._signal_diff(info.signal)
             if diff:
                 self.queue.push_triage(TriageItem(
-                    prog=p.clone(), call_index=info.index, signal=diff))
+                    prog=p.clone(), call_index=info.index, signal=diff,
+                    origin=origin))
         return infos
 
     # ---- triage (reference triageInput fuzzer.go:521-625) ----
@@ -356,11 +391,21 @@ class Fuzzer:
                 item.prog, item.call_index, pred)
 
         sig_list = sorted(inter)
-        self._note_signal(sig_list)
+        fresh = self._note_signal(sig_list)
+        # credit the new signal (and, below, the corpus addition) to the
+        # phase / operators that produced the input, not to the triage
+        # step — and before the corpus dedup: a program that minimizes to
+        # an already-known entry still contributed its fresh PCs, which
+        # new_signal_total just counted
+        origin = item.origin or Provenance(
+            _attr.PHASE_CANDIDATE if item.from_candidate
+            else _attr.PHASE_MUTATE)
+        self._ledger.record_new_signal(origin.phase, origin.ops, fresh)
         if not self._add_corpus(item.prog, sig_list):
             return  # minimized to an already-known program
         self.stats["new_inputs"] += 1
         self._m_new_inputs.inc()
+        self._ledger.record_corpus_add(origin.phase, origin.ops)
         self.manager.new_input(serialize(item.prog), item.call_index,
                                sig_list, sorted(cover))
         self.queue.push_smash(SmashItem(item.prog, item.call_index))
@@ -400,9 +445,10 @@ class Fuzzer:
             self._fail_call(item.prog, item.call_index)
         for i in range(self.cfg.smash_mutations):
             p = item.prog.clone()
-            mutate(p, self.rng, self.cfg.program_length,
-                   ct=self.choice_table, corpus=self.corpus)
-            self.execute(p, "exec_smash")
+            ops = mutate(p, self.rng, self.cfg.program_length,
+                         ct=self.choice_table, corpus=self.corpus)
+            self.execute(p, "exec_smash",
+                         origin=Provenance(_attr.PHASE_SMASH, ops))
 
     def _fail_call(self, p: Prog, call_index: int) -> None:
         for nth in range(100):  # 0-based; executor adds 1
@@ -483,12 +529,14 @@ class Fuzzer:
         opts = ExecOpts()
         batch_sigs = []
         for i in range(len(batch)):
+            origin = Provenance(_attr.PHASE_MUTATE,
+                                ops_from_mask(batch.op_mask(i)))
             stream = batch.streams[i]
             if stream is None:
                 p = batch.decode(i)
                 if p is None:
                     continue
-                infos = self.execute(p, "exec_fuzz")
+                infos = self.execute(p, "exec_fuzz", origin=origin)
                 batch_sigs.append(sorted(
                     {s for info in infos or () for s in info.signal}))
                 continue
@@ -508,6 +556,7 @@ class Fuzzer:
             self.stats["exec_total"] += 1
             self.stats["exec_fuzz"] = self.stats.get("exec_fuzz", 0) + 1
             self._m_exec_total.inc()
+            self._ledger.record_exec(origin.phase, origin.ops)
             if failed or hanged:
                 continue
             decoded = None
@@ -520,7 +569,7 @@ class Fuzzer:
                 if decoded is not None and info.index < len(decoded.calls):
                     self.queue.push_triage(TriageItem(
                         prog=decoded.clone(), call_index=info.index,
-                        signal=diff))
+                        signal=diff, origin=origin))
             batch_sigs.append(sorted(
                 {s for info in infos for s in info.signal}))
         self._fold_batch_signal(batch_sigs)
@@ -567,9 +616,10 @@ class Fuzzer:
             self.execute(p, "exec_gen")
         else:
             p = self.corpus[self.rng.intn(len(self.corpus))].clone()
-            mutate(p, self.rng, self.cfg.program_length,
-                   ct=self.choice_table, corpus=self.corpus)
-            self.execute(p, "exec_fuzz")
+            ops = mutate(p, self.rng, self.cfg.program_length,
+                         ct=self.choice_table, corpus=self.corpus)
+            self.execute(p, "exec_fuzz",
+                         origin=Provenance(_attr.PHASE_MUTATE, ops))
 
     def loop(self, iterations: int = 0, duration: float = 0.0) -> None:
         t0 = time.time()
@@ -657,6 +707,25 @@ class _DevicePipeline:
         self.target = target
         self._corpus_encoded: List = []
 
+        # device-health gauges (ISSUE 2): read-on-demand callbacks, so a
+        # /metrics or sampler tick always sees live state.  Buffer bytes
+        # come from jax.live_arrays() — the process-wide live device
+        # allocations, which on the 1-pipeline-per-process deployments is
+        # the pipeline's working set.
+        reg = get_registry()
+        self._g_occupancy = reg.gauge(
+            "device_batch_occupancy",
+            help="fraction of the last device batch kept after the "
+                 "on-device stale-candidate gate")
+
+        def _live_bytes():
+            return sum(getattr(a, "nbytes", 0) for a in jax.live_arrays())
+
+        reg.gauge(
+            "device_live_buffer_bytes",
+            help="bytes of live device arrays (jax.live_arrays)"
+        ).set_fn(_live_bytes)
+
     def add_corpus(self, p: Prog) -> None:
         batch = self._ProgBatch.empty(self.fmt, 1)
         try:
@@ -680,9 +749,9 @@ class _DevicePipeline:
         data = np.stack([self._corpus_encoded[i][2] for i in idx])
         sb = self._shardings["batch"]
         cid, sval, data = (jax.device_put(x, sb) for x in (cid, sval, data))
-        cid, sval, data, self._sig_shard, fresh = self._step(
+        cid, sval, data, self._sig_shard, fresh, op_mask = self._step(
             kmut, cid, sval, data, self._sig_shard)
-        return cid, sval, data, fresh
+        return cid, sval, data, fresh, op_mask
 
     def candidates(self, corpus: List[Prog]) -> Optional["_DeviceBatch"]:
         """Return the previously launched batch — raw exec streams with a
@@ -699,14 +768,18 @@ class _DevicePipeline:
         self._pending = self._launch()
         if done is None:
             return None
-        cid, sval, data, fresh = (np.asarray(x) for x in done)
+        cid, sval, data, fresh, op_mask = (np.asarray(x) for x in done)
         keep = np.nonzero(fresh)[0]
-        dropped = int(cid.shape[0] - keep.size)
+        total = int(cid.shape[0])
+        dropped = int(total - keep.size)
+        self._g_occupancy.set(keep.size / total if total else 0.0)
         if keep.size < cid.shape[0]:
             cid, sval, data = cid[keep], sval[keep], data[keep]
+            op_mask = op_mask[keep]
         batch = self._ProgBatch(call_id=cid, slot_val=sval, data=data)
         streams = self._execgen.emit_batch(batch)
-        return _DeviceBatch(self, batch, streams, dropped=dropped)
+        return _DeviceBatch(self, batch, streams, dropped=dropped,
+                            op_masks=op_mask)
 
 
 class _DeviceBatch:
@@ -714,15 +787,23 @@ class _DeviceBatch:
     row needs the decode fallback) plus lazy row decoding for triage."""
 
     def __init__(self, pipe: "_DevicePipeline", batch, streams,
-                 dropped: int = 0):
+                 dropped: int = 0, op_masks=None):
         self.pipe = pipe
         self.batch = batch
         self.streams = streams
         self.dropped = dropped  # stale rows gated off on device
+        self.op_masks = op_masks  # [B] u32 per-row operator provenance
         self._decoded: Dict[int, Optional[Prog]] = {}
 
     def __len__(self) -> int:
         return len(self.streams)
+
+    def op_mask(self, row: int) -> int:
+        """Mutation-operator bitmask for one row (0 when the pipeline ran
+        without provenance tracking)."""
+        if self.op_masks is None:
+            return 0
+        return int(self.op_masks[row])
 
     def call_ids(self, row: int) -> List[int]:
         """Stream call ids: prelude mmap + the row's active calls (matches

@@ -19,6 +19,10 @@ class TriageItem:
     signal: List[int]
     from_candidate: bool = False
     minimized: bool = False
+    # provenance of the input that produced the new signal (phase +
+    # mutation-operator indices) — the attribution ledger credits the
+    # eventual corpus addition to it, not to the triage step
+    origin: Optional[object] = None
 
 
 @dataclass

@@ -53,6 +53,11 @@ class ManagerConfig:
     hub_addr: str = ""
     hub_key: str = ""
     kernel_obj: str = ""  # vmlinux path for the /cover symbolized report
+    # campaign analytics (ISSUE 2): registry sampling cadence/series bound
+    # for /stats.json and the /dashboard sparklines; interval <= 0 keeps
+    # the sampler constructed (tests drive ticks by hand) but unstarted
+    analytics_interval: float = 5.0
+    analytics_capacity: int = 240
     dashboard_addr: str = ""
     dashboard_client: str = ""
     dashboard_key: str = ""
@@ -146,6 +151,30 @@ class Manager:
         self.db = DB.open(os.path.join(cfg.workdir, "corpus.db"))
         self._load_corpus()
 
+        # campaign time-series: the registry snapshot plus this manager's
+        # own trajectory values, sampled into bounded downsampling series
+        # served on /stats.json and drawn by /dashboard.  The extra()
+        # callback is weakref-bound like the gauges: the sampler thread
+        # must not pin a closed manager alive.
+        from ..telemetry import RegistrySampler
+
+        def _extra():
+            m = ref()
+            if m is None:
+                return {}
+            snap = m.snapshot()
+            return {
+                "manager_corpus": snap["corpus"],
+                "manager_signal": snap["signal"],
+                "manager_crashes": snap["crashes"],
+                "manager_candidates": snap["candidates"],
+                "manager_fuzzers": snap["fuzzers"],
+            }
+
+        self.sampler = RegistrySampler(
+            interval=cfg.analytics_interval,
+            capacity=cfg.analytics_capacity, extra=_extra)
+
         self.rpc = RpcServer(_RpcHandler(self), *self._split(cfg.rpc))
         self.rpc.start()
         self.http = None
@@ -154,6 +183,10 @@ class Manager:
 
             self.http = ManagerHttp(self, *self._split(cfg.http))
             self.http.start()
+        # started only once the servers are up: a failed __init__ (bound
+        # port, bad workdir) must not leak a forever-ticking daemon thread
+        if cfg.analytics_interval > 0:
+            self.sampler.start()
         self._bench_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         if cfg.bench_file:
@@ -552,6 +585,8 @@ class Manager:
 
     def close(self) -> None:
         self._stop.set()
+        if getattr(self, "sampler", None) is not None:
+            self.sampler.stop()
         for g, fn in getattr(self, "_gauge_fns", ()):
             g.clear_fn(fn)
         self.rpc.stop()

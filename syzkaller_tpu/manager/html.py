@@ -18,17 +18,36 @@ import threading
 import urllib.parse
 from typing import Dict, List, Optional
 
+import time
+
 from ..prog.encoding import call_set
-from ..telemetry import get_registry, get_tracer
+from ..telemetry import get_ledger, get_registry, get_tracer, rate_points
 
 _STYLE = """
 <style>
-body { font-family: monospace; margin: 1em 2em; }
+body { font-family: monospace; margin: 1em 2em;
+       color-scheme: light;
+       background: var(--surface-1); color: var(--text-primary);
+       --surface-1: #fcfcfb; --text-primary: #0b0b0b;
+       --text-secondary: #52514e; --series-1: #2a78d6; }
+@media (prefers-color-scheme: dark) {
+  body { color-scheme: dark;
+         --surface-1: #1a1a19; --text-primary: #ffffff;
+         --text-secondary: #c3c2b7; --series-1: #3987e5; }
+}
 table { border-collapse: collapse; }
 td, th { border: 1px solid #999; padding: 2px 8px; text-align: left; }
-th { background: #eee; }
+th { background: rgba(153,153,153,0.15); }
 a { text-decoration: none; }
 h1 { font-size: 1.3em; }
+.sparks { display: flex; flex-wrap: wrap; gap: 16px; }
+.spark { border: 1px solid #999; padding: 6px 10px; }
+.spark .t { color: var(--text-secondary); }
+.spark .v { font-weight: bold; }
+.spark polyline { stroke: var(--series-1); stroke-width: 2;
+                  fill: none; stroke-linejoin: round; }
+.spark line.base { stroke: var(--text-secondary); stroke-width: 1;
+                   opacity: 0.35; }
 </style>
 """
 
@@ -50,6 +69,51 @@ def _table(headers: List[str], rows: List[List[str]],
                    + "</tr>")
     out.append("</table>")
     return "".join(out)
+
+
+def _fmt_num(v) -> str:
+    if isinstance(v, float) and v != int(v):
+        return f"{v:.4g}"
+    return str(int(v)) if isinstance(v, (int, float)) else str(v)
+
+
+def _spark_panel(title: str, ts: List[float], vals: List[float],
+                 w: int = 260, h: int = 48) -> str:
+    """One single-series sparkline panel: inline SVG polyline (the stroke
+    carries "series", the title carries identity — no legend needed for
+    one series), latest value as text, native <title> tooltips on the
+    per-point hover targets."""
+    n = len(vals)
+    head = (f'<div class="spark"><div class="t">{_html.escape(title)}'
+            f'</div>')
+    if n < 2:
+        return head + '<div class="v">no data yet</div></div>'
+    t0, t1 = ts[0], ts[-1]
+    lo, hi = min(vals), max(vals)
+    span_t = (t1 - t0) or 1.0
+    span_v = (hi - lo) or 1.0
+    pad = 3
+
+    def x(t):
+        return pad + (t - t0) / span_t * (w - 2 * pad)
+
+    def y(v):
+        return h - pad - (v - lo) / span_v * (h - 2 * pad)
+
+    pts = " ".join(f"{x(t):.1f},{y(v):.1f}" for t, v in zip(ts, vals))
+    hovers = "".join(
+        f'<circle cx="{x(t):.1f}" cy="{y(v):.1f}" r="6" fill="transparent">'
+        f"<title>{_fmt_num(v)} @ +{t - t0:.0f}s</title></circle>"
+        for t, v in zip(ts, vals))
+    svg = (f'<svg width="{w}" height="{h}" role="img" '
+           f'aria-label="{_html.escape(title)}">'
+           f'<line class="base" x1="{pad}" y1="{y(lo):.1f}"'
+           f' x2="{w - pad}" y2="{y(lo):.1f}"/>'
+           f'<polyline points="{pts}"/>{hovers}</svg>')
+    return (head + svg +
+            f'<div class="v">{_fmt_num(vals[-1])}'
+            f'<span class="t"> (min {_fmt_num(lo)}, max {_fmt_num(hi)}, '
+            f'{n} pts)</span></div></div>')
 
 
 class ManagerHttp:
@@ -77,6 +141,8 @@ class ManagerHttp:
                         "/rawcover": ui._rawcover,
                         "/prio": ui._prio,
                         "/stats": ui._stats,
+                        "/stats.json": ui._stats_json,
+                        "/dashboard": ui._dashboard,
                         "/metrics": ui._metrics,
                         "/trace": ui._trace,
                     }.get(url.path)
@@ -128,7 +194,9 @@ class ManagerHttp:
         body = (
             f'<p><a href="/corpus">corpus</a> | <a href="/cover">cover</a>'
             f' | <a href="/prio">prio</a> | <a href="/rawcover">rawcover</a>'
-            f' | <a href="/stats">stats.json</a>'
+            f' | <a href="/dashboard">dashboard</a>'
+            f' | <a href="/stats">stats</a>'
+            f' | <a href="/stats.json">stats.json</a>'
             f' | <a href="/metrics">metrics</a>'
             f' | <a href="/trace">trace</a></p>'
             + "<h2>stats</h2>" + _table(["stat", "value"], stats_rows)
@@ -248,6 +316,101 @@ class ManagerHttp:
     def _stats(self, q) -> tuple:
         return ("application/json",
                 json.dumps(self.mgr.snapshot(), sort_keys=True).encode())
+
+    # ---- campaign analytics (ISSUE 2: series + attribution) ----
+
+    def _stats_json(self, q) -> tuple:
+        """Ring-buffer time series (registry snapshot sampled on the
+        manager's analytics interval) + the phase/operator attribution
+        ledger + a point-in-time snapshot, as one JSON document."""
+        sampler = getattr(self.mgr, "sampler", None)
+        payload = {
+            "now": time.time(),
+            "interval": sampler.interval if sampler else None,
+            "samples": sampler.samples_taken if sampler else 0,
+            "series": sampler.store.to_dict() if sampler else {},
+            "attribution": get_ledger().snapshot(),
+            "snapshot": self.mgr.snapshot(),
+        }
+        return ("application/json",
+                json.dumps(payload, sort_keys=True).encode())
+
+    @staticmethod
+    def _series(stored, *names):
+        """First sampled series among ``names`` that has actually moved,
+        as (ts, vals); ``stored`` is one store.to_dict() snapshot shared
+        by all panels so they render a consistent tick.  All-zero series
+        are passed over so the preference order works across topologies:
+        the bare counters exist (at 0) in every manager process, but in
+        the RPC deployment only the fleet_* counters move — a flat-zero
+        first choice must not shadow the live fallback.  If nothing
+        moved, the first existing series is returned (an honest flat 0)."""
+        first = ([], [])
+        for n in names:
+            s = stored.get(n)
+            if s and s["t"]:
+                if any(s["v"]):
+                    return s["t"], s["v"]
+                if not first[0]:
+                    first = (s["t"], s["v"])
+        return first
+
+    def _dashboard(self, q) -> tuple:
+        """Campaign dashboard: sparklines for the trajectory claims
+        (signal growth, exec rate, crash rate, corpus) plus device-health
+        gauges and the per-operator yield table.  Values live in the
+        tables/labels (text ink); the sparkline stroke only says "this is
+        the series" — single-series panels, titles name them."""
+        parts = ['<p><a href="/">back</a> | '
+                 '<a href="/stats.json">stats.json</a></p>']
+        sampler = getattr(self.mgr, "sampler", None)
+        stored = sampler.store.to_dict() if sampler else {}
+        panels = []
+        for title, names, as_rate in (
+                ("signal growth", ("manager_signal", "max_signal_size"),
+                 False),
+                ("exec rate /s", ("exec_total", "fleet_exec_total"), True),
+                ("crash rate /s", ("manager_crashes", "crashes"), True),
+                ("corpus size", ("manager_corpus", "corpus_size"), False)):
+            ts, vals = self._series(stored, *names)
+            if as_rate:
+                pts = rate_points(ts, vals)
+                ts = [t for t, _ in pts]
+                vals = [v for _, v in pts]
+            panels.append(_spark_panel(title, ts, vals))
+        parts.append('<div class="sparks">' + "".join(panels) + "</div>")
+
+        snap = get_registry().snapshot()
+        health = [[k, _fmt_num(snap[k])] for k in (
+            "device_batch_occupancy", "device_jit_cache_entries",
+            "device_jit_compiles_total", "device_live_buffer_bytes",
+            "device_batches_total") if k in snap]
+        if health:
+            parts.append("<h2>device health</h2>"
+                         + _table(["gauge", "value"], health))
+
+        att = get_ledger().snapshot()
+        cols = ["execs", "corpus_adds", "new_signal", "adds_per_kexec",
+                "signal_per_kexec"]
+        ops = att.get("operators", {})
+        if ops:
+            rows = [[name] + [_fmt_num(c[k]) for k in cols]
+                    for name, c in sorted(
+                        ops.items(),
+                        key=lambda kv: -kv[1]["adds_per_kexec"])]
+            parts.append("<h2>per-operator yield</h2>"
+                         + _table(["operator"] + cols, rows))
+        phases = att.get("phases", {})
+        if phases:
+            rows = [[name] + [_fmt_num(c[k]) for k in cols]
+                    for name, c in sorted(phases.items())]
+            parts.append("<h2>per-phase yield</h2>"
+                         + _table(["phase"] + cols, rows))
+        if not ops and not phases:
+            parts.append("<p>no attribution data yet "
+                         "(no triaged corpus additions)</p>")
+        return "text/html", _page(
+            f"{self.mgr.cfg.name} dashboard", "".join(parts))
 
     # ---- telemetry (ISSUE 1: registry + tracer exposition) ----
 

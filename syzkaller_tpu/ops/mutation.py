@@ -373,8 +373,25 @@ def mutate_rows(key, dt: DeviceTables, call_id, slot_val, data,
 # the batch (sizes ~ the reference's op mix) and a fresh random lane
 # permutation each round mixes programs across ops — stratified rather
 # than iid op assignment, with each op body running on only its share of
-# the batch.
-_OP_MIX = ((0, 1), (1, 44), (2, 35), (3, 10), (4, 10))  # (op, weight%)
+# the batch.  The op indices are the attribution ledger's operator index
+# space — imported, not redefined, so a reorder there cannot silently
+# miscredit device-lane provenance (the host mutator imports them the
+# same way in prog/mutation.py).
+from ..telemetry.attribution import (  # noqa: E402
+    OP_DATA,
+    OP_INSERT,
+    OP_REMOVE,
+    OP_SPLICE,
+    OP_VALUE,
+)
+
+_OP_MIX = (  # (op, weight%)
+    (OP_SPLICE, 1),
+    (OP_INSERT, 44),
+    (OP_VALUE, 35),
+    (OP_DATA, 10),
+    (OP_REMOVE, 10),
+)
 
 
 def _op_slices(B: int):
@@ -406,25 +423,39 @@ def _op_slices(B: int):
 
 def mutate_rows_stratified(key, dt: DeviceTables, call_id, slot_val,
                            data, rounds: int = 2):
+    cid, sval, dat, _ = mutate_rows_stratified_traced(
+        key, dt, call_id, slot_val, data, rounds)
+    return cid, sval, dat
+
+
+def mutate_rows_stratified_traced(key, dt: DeviceTables, call_id, slot_val,
+                                  data, rounds: int = 2):
+    """Stratified batch mutation that also returns per-lane provenance:
+    an extra ``op_mask`` [B] uint32 output with bit i set iff operator i
+    (the _OP_MIX index order: splice / insert / value / data / remove)
+    touched that lane in any round.  The mask permutes with its lane, so
+    the engine's attribution ledger can credit the operators that
+    produced each candidate (telemetry.attribution.ops_from_mask)."""
     B = call_id.shape[0]
 
-    ops = [
-        lambda k, row, dn: splice(k, dt, row, dn),
-        lambda k, row, dn: insert_call(k, dt, row),
-        lambda k, row, dn: value_mutate(k, dt, row),
-        lambda k, row, dn: data_mutate(k, dt, row),
-        lambda k, row, dn: remove_call(k, dt, row),
-    ]
+    ops = {
+        OP_SPLICE: lambda k, row, dn: splice(k, dt, row, dn),
+        OP_INSERT: lambda k, row, dn: insert_call(k, dt, row),
+        OP_VALUE: lambda k, row, dn: value_mutate(k, dt, row),
+        OP_DATA: lambda k, row, dn: data_mutate(k, dt, row),
+        OP_REMOVE: lambda k, row, dn: remove_call(k, dt, row),
+    }
     slices = _op_slices(B)
 
     def one_round(carry, rkey):
-        cid, sval, dat = carry
+        cid, sval, dat, opm = carry
         kperm, kops = jax.random.split(rkey)
         perm = jax.random.permutation(kperm, B)
-        cid, sval, dat = cid[perm], sval[perm], dat[perm]
+        cid, sval, dat, opm = cid[perm], sval[perm], dat[perm], opm[perm]
         donor = (jnp.roll(cid, 1, axis=0), jnp.roll(sval, 1, axis=0),
                  jnp.roll(dat, 1, axis=0))
         outs = []
+        bits = []
         for (op_i, _w), (off, n), kop in zip(
                 _OP_MIX, slices, jax.random.split(kops, len(ops))):
             if n == 0:
@@ -435,15 +466,17 @@ def mutate_rows_stratified(key, dt: DeviceTables, call_id, slot_val,
                 keys, (cid[sl], sval[sl], dat[sl]),
                 (donor[0][sl], donor[1][sl], donor[2][sl]))
             outs.append(out)
+            bits.append(opm[sl] | jnp.uint32(1 << op_i))
         cid = jnp.concatenate([o[0] for o in outs])
         sval = jnp.concatenate([o[1] for o in outs])
         dat = jnp.concatenate([o[2] for o in outs])
-        return (cid, sval, dat), None
+        opm = jnp.concatenate(bits)
+        return (cid, sval, dat, opm), None
 
-    (cid, sval, dat), _ = jax.lax.scan(
-        one_round, (call_id, slot_val, data),
+    (cid, sval, dat, opm), _ = jax.lax.scan(
+        one_round, (call_id, slot_val, data, jnp.zeros(B, jnp.uint32)),
         jax.random.split(key, rounds))
-    return cid, sval, dat
+    return cid, sval, dat, opm
 
 
 @partial(jax.jit, static_argnames=("rounds",))

@@ -20,7 +20,9 @@ Mesh construction differs (hub-sync analogue).
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional, Tuple
+from typing import List, Optional, Tuple
+import threading
+import weakref
 
 from . import ensure_x64  # noqa: F401
 
@@ -31,7 +33,41 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.dtables import DeviceTables
 from ..ops import mutation as dmut
-from ..telemetry import get_tracer
+from ..telemetry import get_registry, get_tracer
+
+# Device-health gauge: live jitted steps whose executable caches the
+# ``device_jit_cache_entries`` gauge sums (weakrefs — a gauge must not
+# pin dead pipelines' compiled programs in memory).  The gauge callback
+# runs from any scraping thread (sampler tick, /metrics) concurrently
+# with registrations, so the list is lock-guarded.
+_jit_steps: List["weakref.ref"] = []
+_jit_steps_lock = threading.Lock()
+
+
+def _jit_cache_entries() -> int:
+    total = 0
+    with _jit_steps_lock:
+        live = []
+        for r in _jit_steps:
+            f = r()
+            if f is None:
+                continue
+            live.append(r)
+            try:
+                total += f._cache_size()
+            except Exception:
+                pass  # older jax without _cache_size: count as 0
+        _jit_steps[:] = live
+    return total
+
+
+def _register_jit_step(jitted) -> None:
+    with _jit_steps_lock:
+        _jit_steps.append(weakref.ref(jitted))
+    get_registry().gauge(
+        "device_jit_cache_entries",
+        help="compiled executables cached across live jitted device steps"
+    ).set_fn(_jit_cache_entries)
 
 
 def _timed_step(step, name: str):
@@ -42,6 +78,10 @@ def _timed_step(step, name: str):
     ``<name>.compile`` / ``<name>.dispatch`` land in the Chrome trace and
     as ``span_*_seconds`` histograms in the registry."""
     compiled = [False]
+    _register_jit_step(step)
+    compiles = get_registry().counter(
+        "device_jit_compiles_total",
+        help="first-call JIT compilations of device steps")
 
     def run(*args):
         if compiled[0]:
@@ -51,6 +91,7 @@ def _timed_step(step, name: str):
             out = step(*args)
             jax.block_until_ready(out)
         compiled[0] = True
+        compiles.inc()
         return out
 
     return run
@@ -186,10 +227,11 @@ def _step_body(dt: DeviceTables, rounds: int, key, cid, sval, data,
     i = jax.lax.axis_index(AXIS_FUZZ)
     j = jax.lax.axis_index(AXIS_COVER)
     key = jax.random.fold_in(jax.random.fold_in(key, i), j)
-    cid, sval, data = dmut.mutate_rows_stratified(key, dt, cid, sval, data, rounds)
+    cid, sval, data, op_mask = dmut.mutate_rows_stratified_traced(
+        key, dt, cid, sval, data, rounds)
     sigs = jax.vmap(call_fingerprints)(cid, sval)      # [b, C] u32
     sig_shard, fresh = fold_signals(sig_shard, sigs)
-    return cid, sval, data, sig_shard, fresh
+    return cid, sval, data, sig_shard, fresh, op_mask
 
 
 def make_fuzz_step(mesh: Mesh, dt: DeviceTables, *, rounds: int = 2):
@@ -197,10 +239,12 @@ def make_fuzz_step(mesh: Mesh, dt: DeviceTables, *, rounds: int = 2):
 
     Returns (step, sharding) where
       step(key, cid, sval, data, sig_shard)
-        -> (cid, sval, data, sig_shard, fresh)
+        -> (cid, sval, data, sig_shard, fresh, op_mask)
     cid/sval/data are batch-sharded over ``fuzz`` (batch must divide the
     fuzz axis), sig_shard is the full bitset sharded over ``cover`` (word
-    count must divide the cover axis), key is replicated."""
+    count must divide the cover axis), key is replicated.  ``op_mask``
+    [B] u32 carries per-lane mutation-operator provenance (bit i set iff
+    operator i touched the lane) for the attribution ledger."""
     pspec_batch = P(AXIS_FUZZ)
     pspec_sig = P(AXIS_COVER)
 
@@ -209,7 +253,7 @@ def make_fuzz_step(mesh: Mesh, dt: DeviceTables, *, rounds: int = 2):
         body, mesh=mesh,
         in_specs=(P(), pspec_batch, pspec_batch, pspec_batch, pspec_sig),
         out_specs=(pspec_batch, pspec_batch, pspec_batch, pspec_sig,
-                   pspec_batch))
+                   pspec_batch, pspec_batch))
     step = _timed_step(jax.jit(mapped), "device.fuzz_step")
     shardings = {
         "batch": NamedSharding(mesh, pspec_batch),

@@ -174,12 +174,32 @@ def mutation_args(target, c: Call) -> Tuple[List[Arg], List[Optional[Arg]]]:
     return args, bases
 
 
-def mutate(p: Prog, rng_or_seed, ncalls: int, ct=None, corpus=None) -> None:
-    """Mutate program p in place."""
+# Operator indices shared with the device mix (ops/mutation._OP_MIX) and
+# the attribution ledger: the host arg mutator splits into value
+# (scalar/ptr/resource args) vs data (buffer bytes) to line up with the
+# device's separate value/data kernels.  Imported, not redefined — the
+# attribution module owns the index space (it is dependency-free), so a
+# reorder there cannot silently miscredit host provenance here.
+from ..telemetry.attribution import (  # noqa: E402
+    OP_DATA,
+    OP_INSERT,
+    OP_REMOVE,
+    OP_SPLICE,
+    OP_VALUE,
+)
+
+
+def mutate(p: Prog, rng_or_seed, ncalls: int, ct=None,
+           corpus=None) -> List[int]:
+    """Mutate program p in place.  Returns the operator indices applied
+    (OP_* above, one entry per successful mutation arm, in order) so
+    callers can attribute eventual corpus yield to the operators that
+    produced the mutant."""
     r = rng_or_seed if isinstance(rng_or_seed, RandGen) \
         else RandGen(p.target, seed=rng_or_seed)
     target = p.target
     corpus = corpus or []
+    applied: List[int] = []
 
     retry = True
     stop = False
@@ -199,6 +219,7 @@ def mutate(p: Prog, rng_or_seed, ncalls: int, ct=None, corpus=None) -> None:
             p.calls[idx:idx] = p0c.calls
             while len(p.calls) > ncalls:
                 p.remove_call(len(p.calls) - 1)
+            applied.append(OP_SPLICE)
         elif r.n_out_of(20, 31):
             # insert a new call, biased toward the tail
             if len(p.calls) >= ncalls:
@@ -209,6 +230,7 @@ def mutate(p: Prog, rng_or_seed, ncalls: int, ct=None, corpus=None) -> None:
             s = analyze(ct, p, c)
             calls = r.generate_call(s, p)
             p.insert_before(c, calls)
+            applied.append(OP_INSERT)
         elif r.n_out_of(10, 11):
             # mutate args of a random call
             if not p.calls:
@@ -234,6 +256,8 @@ def mutate(p: Prog, rng_or_seed, ncalls: int, ct=None, corpus=None) -> None:
                 if base is not None and base.res is not None:
                     base_size = base.res.size()
                 _mutate_arg(r, s, p, c, arg)
+                applied.append(OP_DATA if isinstance(arg.typ, BufferType)
+                               else OP_VALUE)
                 updated = True
                 if base is not None and base.res is not None and \
                         base_size < base.res.size():
@@ -253,9 +277,11 @@ def mutate(p: Prog, rng_or_seed, ncalls: int, ct=None, corpus=None) -> None:
                 retry = True
                 continue
             p.remove_call(r.intn(len(p.calls)))
+            applied.append(OP_REMOVE)
 
     for c in p.calls:
         target.sanitize_call(c)
+    return applied
 
 
 def _mutate_arg(r: RandGen, s: State, p: Prog, c: Call, arg: Arg) -> None:

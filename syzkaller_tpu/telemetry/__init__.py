@@ -18,6 +18,14 @@ No jax/numpy imports here: telemetry must load (and stay cheap) on
 host-only deployments.
 """
 
+from .attribution import (  # noqa: F401
+    OP_NAMES,
+    PHASES,
+    AttributionLedger,
+    Provenance,
+    get_ledger,
+    ops_from_mask,
+)
 from .metrics import (  # noqa: F401
     DEFAULT_BUCKETS,
     Counter,
@@ -26,14 +34,22 @@ from .metrics import (  # noqa: F401
     Registry,
     get_registry,
 )
+from .timeseries import (  # noqa: F401
+    RegistrySampler,
+    Series,
+    TimeSeriesStore,
+    rate_points,
+)
 from .trace import Tracer, get_tracer, span, timed  # noqa: F401
 
 
 def telemetry_dump() -> dict:
-    """The --telemetry-out document: metrics snapshot + Chrome trace."""
+    """The --telemetry-out document: metrics snapshot + Chrome trace +
+    the phase/operator attribution ledger."""
     return {
         "metrics": get_registry().snapshot(),
         "trace": get_tracer().chrome_trace(),
+        "attribution": get_ledger().snapshot(),
     }
 
 

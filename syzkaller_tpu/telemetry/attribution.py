@@ -1,0 +1,182 @@
+"""Attribution ledger: which phase / mutation operator earns the corpus.
+
+Coverage-guided fuzzers are judged on trajectories, and trajectories are
+made of *credited* events: every triaged corpus addition started life in
+some phase — generate / mutate / smash / hints / candidate — and, for
+mutations, under some operator (the shared operator index space of
+``ops/mutation.py``'s device mix and ``prog/mutation.py``'s host mix:
+splice / insert / value / data / remove).  The ledger accumulates, per
+phase and per operator:
+
+  - ``execs``        — programs executed with that provenance (the cost);
+  - ``new_signal``   — new max-signal PCs its triaged inputs contributed;
+  - ``corpus_adds``  — inputs it landed in the corpus (the yield);
+
+and ``snapshot()`` derives yield-per-exec from them.  This is the
+per-operator effectiveness data that memoized mutation analysis
+(arxiv 2102.11559) and coverage-guided tensor-compiler fuzzing
+(arxiv 2202.09947) show turns "runs fast" into "finds more": the mix
+weights can be audited against measured yield instead of folklore.
+
+Multi-op provenance (a device lane mutated twice, a host mutate() loop
+applying several ops) credits EVERY operator involved in full — the
+per-operator rows answer "did executions involving op X pay off", so
+their execs/adds columns each sum to >= the phase totals, not equal.
+Phase totals are exact: one exec / one add is credited to exactly one
+phase, and the tests pin sum(phase corpus_adds) == engine new_inputs —
+plus the ``seed`` row, which counts connect-time corpus imports (no
+exec paid, not new_inputs) so seed volume is auditable next to yield.
+
+Like the metrics registry, one process-wide default ledger
+(``get_ledger``) is shared by in-process fuzzers and the manager UI;
+``record_exec`` is one lock + a few dict adds, cheap enough for the
+per-exec hot path (the ISSUE 1 <5% overhead bound test includes it).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, Sequence, Tuple
+
+# Phase vocabulary: where a program's provenance starts.
+PHASE_GENERATE = "generate"
+PHASE_MUTATE = "mutate"
+PHASE_SMASH = "smash"
+PHASE_HINTS = "hints"
+PHASE_CANDIDATE = "candidate"
+PHASE_TRIAGE = "triage"  # re-runs/minimize: pure cost, never yields adds
+PHASE_SEED = "seed"      # corpus loaded from the manager/db at connect
+
+PHASES: Tuple[str, ...] = (
+    PHASE_GENERATE, PHASE_MUTATE, PHASE_SMASH, PHASE_HINTS,
+    PHASE_CANDIDATE, PHASE_TRIAGE, PHASE_SEED)
+
+# Operator index space shared by the device mutator (ops/mutation._OP_MIX
+# order) and the host mutator (prog/mutation.mutate's op arms).
+OP_SPLICE, OP_INSERT, OP_VALUE, OP_DATA, OP_REMOVE = range(5)
+OP_NAMES: Tuple[str, ...] = ("splice", "insert", "value", "data", "remove")
+
+
+def ops_from_mask(mask: int) -> Tuple[int, ...]:
+    """Decode a device-side op bitmask (bit i == operator i applied) into
+    the operator-index tuple the ledger takes."""
+    return tuple(i for i in range(len(OP_NAMES)) if (int(mask) >> i) & 1)
+
+
+class _Cell:
+    __slots__ = ("execs", "new_signal", "corpus_adds")
+
+    def __init__(self):
+        self.execs = 0
+        self.new_signal = 0
+        self.corpus_adds = 0
+
+    def to_dict(self) -> Dict[str, float]:
+        ypk = (1000.0 * self.corpus_adds / self.execs) if self.execs else 0.0
+        spk = (1000.0 * self.new_signal / self.execs) if self.execs else 0.0
+        return {
+            "execs": self.execs,
+            "new_signal": self.new_signal,
+            "corpus_adds": self.corpus_adds,
+            "adds_per_kexec": round(ypk, 4),
+            "signal_per_kexec": round(spk, 4),
+        }
+
+
+class AttributionLedger:
+    """Thread-safe per-phase / per-operator yield accounting."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._phases: Dict[str, _Cell] = {}
+        self._ops: Dict[int, _Cell] = {}
+
+    def _phase(self, phase: str) -> _Cell:
+        c = self._phases.get(phase)
+        if c is None:
+            c = self._phases[phase] = _Cell()
+        return c
+
+    def _op(self, op: int) -> _Cell:
+        c = self._ops.get(op)
+        if c is None:
+            c = self._ops[op] = _Cell()
+        return c
+
+    # ---- recording (engine hot path) ----
+
+    def record_exec(self, phase: str, ops: Sequence[int] = (),
+                    n: int = 1) -> None:
+        with self._lock:
+            self._phase(phase).execs += n
+            for op in ops:
+                self._op(op).execs += n
+
+    def record_new_signal(self, phase: str, ops: Sequence[int],
+                          n: int) -> None:
+        if n <= 0:
+            return
+        with self._lock:
+            self._phase(phase).new_signal += n
+            for op in ops:
+                self._op(op).new_signal += n
+
+    def record_corpus_add(self, phase: str, ops: Sequence[int] = ()) -> None:
+        with self._lock:
+            self._phase(phase).corpus_adds += 1
+            for op in ops:
+                self._op(op).corpus_adds += 1
+
+    # ---- reading ----
+
+    def snapshot(self) -> Dict[str, Dict[str, Dict[str, float]]]:
+        with self._lock:
+            phases = {p: c.to_dict() for p, c in self._phases.items()}
+            ops = {OP_NAMES[o]: c.to_dict()
+                   for o, c in sorted(self._ops.items())
+                   if 0 <= o < len(OP_NAMES)}
+        return {"phases": phases, "operators": ops}
+
+    def totals(self) -> Dict[str, int]:
+        """Exact phase-summed totals (per-operator rows intentionally
+        overlap and are excluded)."""
+        with self._lock:
+            return {
+                "execs": sum(c.execs for c in self._phases.values()),
+                "new_signal": sum(c.new_signal
+                                  for c in self._phases.values()),
+                "corpus_adds": sum(c.corpus_adds
+                                   for c in self._phases.values()),
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._phases.clear()
+            self._ops.clear()
+
+
+class Provenance:
+    """One program's origin: phase + the operator indices that shaped it.
+    Carried on TriageItems so the eventual corpus add credits the source
+    that produced the input, not the triage step that confirmed it."""
+
+    __slots__ = ("phase", "ops")
+
+    def __init__(self, phase: str, ops: Iterable[int] = ()):
+        self.phase = phase
+        # dedupe, order-preserving: an exec is credited once per operator
+        # *involved*, however many times the host mutate() loop drew it
+        self.ops = tuple(dict.fromkeys(ops))
+
+    def __repr__(self) -> str:
+        names = [OP_NAMES[o] for o in self.ops if 0 <= o < len(OP_NAMES)]
+        return f"Provenance({self.phase}{':' if names else ''}{'+'.join(names)})"
+
+
+_default = AttributionLedger()
+
+
+def get_ledger() -> AttributionLedger:
+    """The process-wide default ledger (pairs with metrics.get_registry:
+    in-process fuzzers write it, the manager UI serves it)."""
+    return _default

@@ -1,0 +1,195 @@
+"""Fixed-capacity time series sampled from the metrics registry.
+
+The campaign-analytics spine (ISSUE 2): point-in-time metrics answer
+"how fast is it now", but the paper's claim — TPU-batched mutation finds
+new signal *faster* — is a trajectory claim, so the manager samples the
+registry snapshot on a fixed interval into bounded per-metric series and
+serves them on ``/stats.json`` (and as the inline-SVG sparklines on the
+``/dashboard`` page).
+
+Bounding strategy: each ``Series`` holds at most ``capacity`` points.
+When full it *downsamples in place* — every other point is dropped and
+the effective sampling stride doubles — so a week-long campaign keeps
+its whole trajectory at decreasing resolution instead of a sliding
+window that forgets the start.  Invariants (asserted by the tests):
+
+  - ``len(series) <= capacity`` always;
+  - the first recorded point is never dropped (index 0 survives ``[::2]``),
+    so growth curves keep their true origin;
+  - timestamps stay strictly increasing;
+  - ``stride`` is ``2**k`` times the base interval after k downsamples.
+
+Values are stored exactly as sampled (cumulative counters stay
+cumulative); rate views are computed by the consumer from consecutive
+deltas — downsampling a cumulative series loses no area, whereas
+downsampling a pre-computed rate would.
+
+No jax/numpy imports: like the rest of telemetry this must stay cheap
+and loadable on host-only deployments.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from . import metrics as _metrics
+
+DEFAULT_CAPACITY = 240
+DEFAULT_INTERVAL = 5.0
+
+
+class Series:
+    """One metric's bounded (t, value) history with halving downsample.
+
+    Writer (the sampler tick) and readers (/stats.json, the dashboard)
+    are different threads, and the in-place downsample rebinds ts/vals —
+    a per-series lock keeps every read an aligned (t, v) snapshot."""
+
+    __slots__ = ("name", "capacity", "ts", "vals", "stride", "_lock")
+
+    def __init__(self, name: str, capacity: int = DEFAULT_CAPACITY):
+        if capacity < 4:
+            raise ValueError(f"capacity must be >= 4, got {capacity}")
+        self.name = name
+        self.capacity = capacity
+        self.ts: List[float] = []
+        self.vals: List[float] = []
+        self.stride = 1  # samples merged per kept point (2**downsamples)
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self.ts)
+
+    def append(self, t: float, v: float) -> None:
+        with self._lock:
+            if self.ts and t <= self.ts[-1]:
+                return  # clock went backwards / duplicate tick: monotonic
+            if len(self.ts) >= self.capacity:
+                # halve resolution, keeping index 0 (the campaign origin)
+                # and every other point after it; stride doubles
+                self.ts = self.ts[::2]
+                self.vals = self.vals[::2]
+                self.stride *= 2
+            self.ts.append(t)
+            self.vals.append(v)
+
+    def points(self) -> List[Tuple[float, float]]:
+        with self._lock:
+            return list(zip(self.ts, self.vals))
+
+    def to_dict(self) -> Dict[str, object]:
+        with self._lock:
+            return {"t": list(self.ts), "v": list(self.vals),
+                    "stride": self.stride}
+
+
+class TimeSeriesStore:
+    """Name -> Series map; one ``record_snapshot`` call per sampling tick."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._series: Dict[str, Series] = {}
+
+    def series(self, name: str) -> Series:
+        with self._lock:
+            s = self._series.get(name)
+            if s is None:
+                s = self._series[name] = Series(name, self.capacity)
+            return s
+
+    def record(self, name: str, t: float, v: float) -> None:
+        self.series(name).append(t, v)
+
+    def record_snapshot(self, t: float, snap: Dict[str, float]) -> None:
+        for name, v in snap.items():
+            self.series(name).append(t, v)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def to_dict(self) -> Dict[str, object]:
+        with self._lock:
+            items = list(self._series.items())
+        return {name: s.to_dict() for name, s in items}
+
+
+class RegistrySampler:
+    """Samples a registry snapshot (plus optional extra values) into a
+    TimeSeriesStore on a fixed interval.
+
+    ``sample()`` is callable directly — tests and short mock campaigns
+    drive ticks by hand; ``start()`` runs the same tick from a daemon
+    thread for live managers.  Histogram ``_sum``/``_count`` pairs ride
+    along from ``snapshot()``, so per-phase latency trajectories (e.g.
+    ``span_device_fuzz_step_dispatch_seconds_sum``) come for free.
+    """
+
+    def __init__(self, registry: Optional[_metrics.Registry] = None,
+                 interval: float = DEFAULT_INTERVAL,
+                 capacity: int = DEFAULT_CAPACITY,
+                 extra: Optional[Callable[[], Dict[str, float]]] = None):
+        self.registry = registry
+        self.interval = float(interval)
+        self.store = TimeSeriesStore(capacity)
+        self.extra = extra
+        self.samples_taken = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _reg(self) -> _metrics.Registry:
+        return self.registry or _metrics.get_registry()
+
+    def sample(self, now: Optional[float] = None) -> Dict[str, float]:
+        import time
+
+        t = time.time() if now is None else now
+        snap = dict(self._reg().snapshot())
+        if self.extra is not None:
+            try:
+                snap.update(self.extra())
+            except Exception:
+                pass  # a dying manager must not kill the sampler tick
+        self.store.record_snapshot(t, snap)
+        self.samples_taken += 1
+        return snap
+
+    def start(self) -> None:
+        if self.interval <= 0:
+            return  # manual-tick mode: a 0-interval loop would spin hot
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="telemetry-sampler", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2.0)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.sample()
+            except Exception:
+                pass  # sampling must never take the manager down
+
+
+def rate_points(ts: List[float], vals: List[float]
+                ) -> List[Tuple[float, float]]:
+    """Per-second rate between consecutive samples of a cumulative
+    series: [(t_i, (v_i - v_{i-1}) / (t_i - t_{i-1})), ...].  Negative
+    deltas (a counter restarted) clamp to 0 rather than plotting a dip
+    to a bogus negative rate."""
+    out: List[Tuple[float, float]] = []
+    for i in range(1, len(ts)):
+        dt = ts[i] - ts[i - 1]
+        if dt <= 0:
+            continue
+        out.append((ts[i], max(vals[i] - vals[i - 1], 0) / dt))
+    return out

@@ -1,0 +1,115 @@
+"""Metric-namespace linter: keep registry metric names coherent.
+
+Walks the package source for literal metric registrations —
+``<registry>.counter("name", ...)`` / ``.gauge`` / ``.histogram`` — and
+fails on:
+
+  - non-snake_case names (the Prometheus exposition and the BENCH JSON
+    schema both assume ``[a-z][a-z0-9_]*``);
+  - undocumented names: a name every registration site leaves without a
+    ``help=`` string never reaches ``# HELP`` on /metrics, so operators
+    can't tell what it measures.  One documented site is enough — hot
+    paths may re-bind the same metric without repeating the help text.
+
+Dynamically built names (``"fleet_" + k``, the tracer's ``span_*``
+histograms) are exempt by construction: only string-literal first
+arguments are checked.  Invoked from the test suite (tests/test_analytics
+.py) so the namespace stays coherent as it grows; also runnable as
+``python -m syzkaller_tpu.tools.check_metrics``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import Dict, List, NamedTuple
+
+SNAKE_CASE = re.compile(r"^[a-z][a-z0-9_]*$")
+METRIC_METHODS = ("counter", "gauge", "histogram")
+
+
+class Registration(NamedTuple):
+    name: str
+    file: str
+    line: int
+    has_help: bool
+
+
+def _package_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def collect_registrations(root: str = "") -> List[Registration]:
+    root = root or _package_root()
+    regs: List[Registration] = []
+    for dirpath, _dirs, files in os.walk(root):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, "r", encoding="utf-8") as fh:
+                try:
+                    tree = ast.parse(fh.read(), filename=path)
+                except SyntaxError:
+                    continue  # not this tool's failure to report
+            rel = os.path.relpath(path, root)
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in METRIC_METHODS
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and isinstance(node.args[0].value, str)):
+                    continue
+                has_help = any(
+                    kw.arg == "help" and not (
+                        isinstance(kw.value, ast.Constant)
+                        and kw.value.value in ("", None))
+                    for kw in node.keywords) or (
+                    len(node.args) > 1
+                    and isinstance(node.args[1], ast.Constant)
+                    and bool(node.args[1].value))
+                regs.append(Registration(
+                    node.args[0].value, rel, node.lineno, has_help))
+    return regs
+
+
+def check(root: str = "") -> List[str]:
+    """Lint the package's metric namespace; returns problem strings
+    (empty list == clean)."""
+    return _problems(collect_registrations(root))
+
+
+def _problems(regs: List[Registration]) -> List[str]:
+    problems: List[str] = []
+    documented: Dict[str, bool] = {}
+    for r in regs:
+        documented[r.name] = documented.get(r.name, False) or r.has_help
+        if not SNAKE_CASE.match(r.name):
+            problems.append(
+                f"{r.file}:{r.line}: metric {r.name!r} is not snake_case")
+    for name in sorted(n for n, ok in documented.items() if not ok):
+        sites = ", ".join(f"{r.file}:{r.line}" for r in regs
+                          if r.name == name)
+        problems.append(
+            f"metric {name!r} has no help= at any registration site "
+            f"({sites})")
+    return problems
+
+
+def main(argv=None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    root = args[0] if args else ""
+    regs = collect_registrations(root)
+    problems = _problems(regs)
+    for p in problems:
+        print(p, file=sys.stderr)
+    print(f"check_metrics: {len(regs)} literal registrations, "
+          f"{len(problems)} problem(s)")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

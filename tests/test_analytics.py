@@ -1,0 +1,345 @@
+"""Campaign-analytics tests (ISSUE 2): ring-buffer series invariants
+(capacity bound, origin preservation, monotonic timestamps, stride
+doubling), the registry sampler, the phase/operator attribution ledger
+(totals exactly equal the engine's corpus additions), the manager's
+/stats.json and /dashboard endpoints after a short mock campaign, and
+the metric-namespace linter that keeps registry names coherent."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from syzkaller_tpu.telemetry import (
+    AttributionLedger,
+    Provenance,
+    RegistrySampler,
+    Series,
+    TimeSeriesStore,
+    get_ledger,
+    get_registry,
+    ops_from_mask,
+    rate_points,
+)
+from syzkaller_tpu.telemetry.attribution import (
+    OP_INSERT,
+    OP_NAMES,
+    OP_SPLICE,
+    OP_VALUE,
+    PHASE_GENERATE,
+    PHASE_MUTATE,
+)
+from syzkaller_tpu.telemetry.metrics import Registry
+
+
+# ---- ring-buffer series ----
+
+
+def test_series_capacity_and_downsample_invariants():
+    cap = 16
+    s = Series("m", capacity=cap)
+    n = 500
+    for i in range(n):
+        s.append(float(i), float(i * 10))
+        # invariant: the bound holds after EVERY append, not just at the end
+        assert len(s) <= cap
+    # the campaign origin is never dropped
+    assert s.ts[0] == 0.0 and s.vals[0] == 0.0
+    # the newest point always survives (it was just appended)
+    assert s.ts[-1] == float(n - 1)
+    # timestamps strictly increasing
+    assert all(a < b for a, b in zip(s.ts, s.ts[1:]))
+    # stride is 2**k after k in-place downsamples
+    assert s.stride & (s.stride - 1) == 0 and s.stride > 1
+    # values were stored exactly as sampled (no averaging)
+    assert all(v == t * 10 for t, v in s.points())
+
+
+def test_series_rejects_nonmonotonic_time():
+    s = Series("m", capacity=8)
+    s.append(10.0, 1.0)
+    s.append(10.0, 2.0)  # duplicate tick: dropped
+    s.append(9.0, 3.0)   # clock went backwards: dropped
+    s.append(11.0, 4.0)
+    assert s.points() == [(10.0, 1.0), (11.0, 4.0)]
+
+
+def test_series_minimum_capacity():
+    with pytest.raises(ValueError):
+        Series("m", capacity=2)
+
+
+def test_store_snapshot_roundtrip():
+    st = TimeSeriesStore(capacity=8)
+    st.record_snapshot(1.0, {"a": 1, "b": 10})
+    st.record_snapshot(2.0, {"a": 2, "b": 20})
+    st.record("c", 3.0, 30)
+    assert st.names() == ["a", "b", "c"]
+    doc = json.loads(json.dumps(st.to_dict()))
+    assert doc["a"]["t"] == [1.0, 2.0]
+    assert doc["b"]["v"] == [10, 20]
+    assert doc["c"]["stride"] == 1
+
+
+def test_rate_points_clamps_counter_restart():
+    ts = [0.0, 10.0, 20.0, 30.0]
+    vals = [0.0, 100.0, 5.0, 15.0]  # counter restarted between t=10, t=20
+    pts = rate_points(ts, vals)
+    assert pts == [(10.0, 10.0), (20.0, 0.0), (30.0, 1.0)]
+
+
+def test_registry_sampler_manual_ticks():
+    reg = Registry()
+    reg.counter("exec_total").inc(5)
+    extra_calls = []
+
+    def extra():
+        extra_calls.append(1)
+        if len(extra_calls) > 1:
+            raise RuntimeError("dying manager")  # must not kill the tick
+        return {"manager_corpus": 7}
+
+    smp = RegistrySampler(registry=reg, interval=0, extra=extra)
+    smp.sample(now=1.0)
+    reg.counter("exec_total").inc(3)
+    smp.sample(now=2.0)
+    assert smp.samples_taken == 2
+    doc = smp.store.to_dict()
+    assert doc["exec_total"]["v"] == [5, 8]
+    assert doc["manager_corpus"]["v"] == [7]  # second tick's extra() died
+    assert len(extra_calls) == 2
+
+
+def test_registry_sampler_thread_lifecycle():
+    reg = Registry()
+    reg.gauge("g").set(1)
+    smp = RegistrySampler(registry=reg, interval=0.01)
+    smp.start()
+    smp.start()  # idempotent
+    deadline = time.time() + 5.0
+    while smp.samples_taken < 3 and time.time() < deadline:
+        time.sleep(0.01)
+    smp.stop()
+    taken = smp.samples_taken
+    assert taken >= 3
+    time.sleep(0.05)
+    assert smp.samples_taken == taken  # really stopped
+
+
+# ---- attribution ledger ----
+
+
+def test_ops_from_mask():
+    assert ops_from_mask(0) == ()
+    assert ops_from_mask(0b10101) == (0, 2, 4)
+    assert ops_from_mask(0b11111) == (0, 1, 2, 3, 4)
+
+
+def test_operator_index_space_is_shared():
+    """The host mutator imports its OP_* indices from the attribution
+    module, and the device mix enumerates exactly the same index space —
+    a reorder in any copy would silently miscredit provenance."""
+    from syzkaller_tpu.prog import mutation as host_mut
+    from syzkaller_tpu.telemetry import attribution as att
+
+    assert (host_mut.OP_SPLICE, host_mut.OP_INSERT, host_mut.OP_VALUE,
+            host_mut.OP_DATA, host_mut.OP_REMOVE) == tuple(range(5))
+    assert host_mut.OP_SPLICE is att.OP_SPLICE
+    ops_mut = pytest.importorskip("syzkaller_tpu.ops.mutation")
+    assert [op for op, _w in ops_mut._OP_MIX] \
+        == list(range(len(att.OP_NAMES)))
+
+
+def test_provenance_dedupes_operators():
+    p = Provenance(PHASE_MUTATE, [OP_VALUE, OP_VALUE, OP_INSERT, OP_VALUE])
+    assert p.ops == (OP_VALUE, OP_INSERT)
+    assert "mutate" in repr(p) and "value" in repr(p)
+
+
+def test_ledger_accounting():
+    led = AttributionLedger()
+    led.record_exec(PHASE_MUTATE, (OP_SPLICE, OP_VALUE), n=10)
+    led.record_exec(PHASE_GENERATE)
+    led.record_new_signal(PHASE_MUTATE, (OP_SPLICE, OP_VALUE), 4)
+    led.record_new_signal(PHASE_MUTATE, (OP_SPLICE,), 0)  # no-op
+    led.record_corpus_add(PHASE_MUTATE, (OP_SPLICE, OP_VALUE))
+    snap = led.snapshot()
+    # phase totals are exact
+    assert snap["phases"]["mutate"] == {
+        "execs": 10, "new_signal": 4, "corpus_adds": 1,
+        "adds_per_kexec": 100.0, "signal_per_kexec": 400.0}
+    assert snap["phases"]["generate"]["execs"] == 1
+    # per-operator rows each credit the full event
+    for op in ("splice", "value"):
+        assert snap["operators"][op]["execs"] == 10
+        assert snap["operators"][op]["corpus_adds"] == 1
+    assert led.totals() == {"execs": 11, "new_signal": 4, "corpus_adds": 1}
+    led.reset()
+    assert led.totals() == {"execs": 0, "new_signal": 0, "corpus_adds": 0}
+    json.dumps(snap)
+
+
+def test_ledger_totals_match_mock_campaign():
+    """Acceptance: after a short mock campaign the ledger's phase-summed
+    totals exactly equal the engine's own counters — every exec and every
+    corpus addition is credited to exactly one phase."""
+    from syzkaller_tpu.engine.fuzzer import Fuzzer, FuzzerConfig
+    from syzkaller_tpu.prog import get_target
+
+    led = get_ledger()
+    before = led.totals()
+    target = get_target("linux", "amd64")
+    cfg = FuzzerConfig(mock=True, use_device=False, smash_mutations=2)
+    with Fuzzer(target, cfg) as f:
+        f.loop(iterations=80)
+        execs, adds = f.stats["exec_total"], f.stats["new_inputs"]
+    after = led.totals()
+    assert after["execs"] - before["execs"] == execs
+    assert after["corpus_adds"] - before["corpus_adds"] == adds > 0
+    snap = led.snapshot()
+    # mutation yield was attributed to concrete operators
+    assert set(snap["operators"]) <= set(OP_NAMES)
+    assert sum(c["execs"] for c in snap["operators"].values()) > 0
+
+
+def test_seed_corpus_credits_seed_phase():
+    """Connect-time corpus imports land in the ledger's seed row (no
+    exec paid, no new_inputs bump), so seed volume is auditable next to
+    earned yield; duplicates are not double-credited."""
+    from syzkaller_tpu.engine.fuzzer import Fuzzer, FuzzerConfig
+    from syzkaller_tpu.prog import get_target
+    from syzkaller_tpu.prog.encoding import serialize
+    from syzkaller_tpu.prog.generation import generate
+
+    target = get_target("linux", "amd64")
+    led = get_ledger()
+
+    def seed_adds():
+        return led.snapshot()["phases"].get(
+            "seed", {"corpus_adds": 0})["corpus_adds"]
+
+    before = seed_adds()
+    cfg = FuzzerConfig(mock=True, use_device=False)
+    with Fuzzer(target, cfg) as f:
+        text = serialize(generate(target, 7, 5))
+        new_inputs = f.stats["new_inputs"]
+        f._add_corpus_text(text)
+        f._add_corpus_text(text)  # duplicate: dropped by the corpus hash
+        assert f.stats["new_inputs"] == new_inputs
+    assert seed_adds() - before == 1
+
+
+# ---- manager endpoints ----
+
+
+def _get(mgr, path: str) -> bytes:
+    with urllib.request.urlopen(f"http://{mgr.http.addr}{path}",
+                                timeout=10) as r:
+        return r.read()
+
+
+@pytest.fixture()
+def campaign_manager(tmp_path):
+    """A manager over a registry/ledger already populated by a short mock
+    campaign, its sampler unstarted (interval<=0) so tests drive ticks
+    deterministically."""
+    from syzkaller_tpu.engine.fuzzer import Fuzzer, FuzzerConfig
+    from syzkaller_tpu.manager import Manager, ManagerConfig
+    from syzkaller_tpu.prog import get_target
+
+    target = get_target("linux", "amd64")
+    cfg = FuzzerConfig(mock=True, use_device=False, smash_mutations=2)
+    with Fuzzer(target, cfg) as f:
+        f.loop(iterations=60)
+    m = Manager(ManagerConfig(workdir=str(tmp_path),
+                              analytics_interval=0),
+                target=target)
+    try:
+        now = time.time()
+        m.sampler.sample(now=now)
+        get_registry().counter("exec_total").inc(17)
+        m.sampler.sample(now=now + 5)
+        yield m
+    finally:
+        m.close()
+
+
+def test_stats_json_roundtrip(campaign_manager):
+    m = campaign_manager
+    doc = json.loads(_get(m, "/stats.json"))
+    assert {"now", "interval", "samples", "series", "attribution",
+            "snapshot"} <= set(doc)
+    assert doc["samples"] == 2
+    series = doc["series"]["exec_total"]
+    assert len(series["t"]) == 2 and series["stride"] == 1
+    assert series["v"][1] - series["v"][0] >= 17
+    # the manager's own trajectory rides the extra() callback
+    assert "manager_corpus" in doc["series"]
+    # nonzero attribution after the mock campaign (acceptance criterion)
+    att = doc["attribution"]
+    assert sum(c["corpus_adds"] for c in att["phases"].values()) > 0
+    assert att["operators"]  # per-operator rows populated
+
+
+def test_dashboard_page_renders(campaign_manager):
+    m = campaign_manager
+    page = _get(m, "/dashboard").decode()
+    # sparkline panels with real polylines (>=2 samples were taken)
+    assert "<svg" in page and "<polyline" in page
+    assert "signal growth" in page and "exec rate /s" in page
+    # attribution tables
+    assert "per-operator yield" in page and "per-phase yield" in page
+    for op in ("splice", "insert", "value"):
+        assert op in page
+    # linked from the summary page
+    root = _get(m, "/").decode()
+    assert "/dashboard" in root and "/stats.json" in root
+
+
+def test_stats_json_empty_manager(tmp_path):
+    """A manager with no samples yet still serves valid JSON."""
+    from syzkaller_tpu.manager import Manager, ManagerConfig
+    from syzkaller_tpu.prog import get_target
+
+    m = Manager(ManagerConfig(workdir=str(tmp_path),
+                              analytics_interval=0),
+                target=get_target("linux", "amd64"))
+    try:
+        doc = json.loads(_get(m, "/stats.json"))
+        assert doc["samples"] == 0 and doc["series"] == {}
+        page = _get(m, "/dashboard").decode()
+        assert "no data yet" in page
+    finally:
+        m.close()
+
+
+# ---- metric-namespace linter (CI satellite) ----
+
+
+def test_metric_namespace_is_coherent():
+    from syzkaller_tpu.tools.check_metrics import check, collect_registrations
+
+    regs = collect_registrations()
+    # sanity: the walker actually sees the known registration sites
+    names = {r.name for r in regs}
+    assert {"exec_total", "corpus_size",
+            "device_batch_occupancy"} <= names
+    assert check() == []
+
+
+def test_check_metrics_flags_bad_names(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "reg.counter('CamelCase')\n"
+        "reg.gauge('undocumented_gauge')\n"
+        "reg.histogram('ok_hist', help='documented')\n"
+        "reg.counter('fleet_' + k)  # dynamic: exempt\n")
+    from syzkaller_tpu.tools.check_metrics import check, main
+
+    problems = check(str(tmp_path))
+    assert any("CamelCase" in p and "snake_case" in p for p in problems)
+    assert any("undocumented_gauge" in p and "help=" in p
+               for p in problems)
+    assert not any("ok_hist" in p for p in problems)
+    assert main([str(tmp_path)]) == 1
