@@ -224,13 +224,20 @@ def bench_cover_merge(n_traces=10_000, pcs=64, nbits=1 << 22):
 # config[2]: end-to-end triage loop
 
 
+E2E_DEVICE_PROCS = 4  # executor envs the device-pipeline drain fans over
+
+
 def bench_e2e(target, seconds=18.0):
     from syzkaller_tpu.engine.fuzzer import Fuzzer, FuzzerConfig
 
     def run(use_device: bool, mock: bool):
+        # the device pipeline drains batches across an executor fleet
+        # (ISSUE 3 fan-out); the host-only loop stays the 1-proc
+        # single-threaded reference baseline
         cfg = FuzzerConfig(
             mock=mock, use_device=use_device, device_batch=256,
-            program_length=16, device_period=2, smash_mutations=4)
+            program_length=16, device_period=2, smash_mutations=4,
+            procs=E2E_DEVICE_PROCS if use_device else 1)
         with Fuzzer(target, cfg) as f:
             # warm up (compiles, first corpus entries)
             f.loop(iterations=30)
@@ -405,7 +412,8 @@ def main(argv=None):
         e2e_dev, e2e_host, executor = bench_e2e(target)
         return {"device_pipeline": round(e2e_dev, 1),
                 "host_only": round(e2e_host, 1),
-                "unit": "execs/sec", "executor": executor}
+                "unit": "execs/sec", "executor": executor,
+                "device_procs": E2E_DEVICE_PROCS}
 
     run_config("e2e_triage", _e2e)
 

@@ -32,6 +32,7 @@ from ..telemetry import (
     get_ledger,
     get_registry,
     ops_from_mask,
+    span,
     timed,
 )
 from ..telemetry import attribution as _attr
@@ -72,6 +73,9 @@ class FuzzerConfig:
     log_programs: bool = False          # emit `executing program` records
     sandbox: str = "none"
     device_period: int = 16             # consume a device batch every N steps
+    # device-resident corpus arena rows (ops/arena.py): encoded programs
+    # stay on the chips; the ring overwrites the oldest beyond this
+    arena_capacity: int = 1024
     # device signal bitsets (sharded proxy set + host max-signal mirror):
     # sized like ops/cover.DEFAULT_BITS — a small mirror saturates with
     # collisions on a real corpus
@@ -120,6 +124,10 @@ class Fuzzer:
         self.max_signal: Set[int] = set()
         self.new_signal: Set[int] = set()
         self._lock = threading.Lock()
+        # guards the wire-stat dict: the parallel device-batch drain bumps
+        # exec counters from worker threads (_record_exec)
+        self._stats_lock = threading.Lock()
+        self._drain_pool = None  # lazy ThreadPoolExecutor over self.envs
 
         # telemetry: self.stats stays the RPC wire shape; the registry
         # carries the same counters plus latencies for /metrics and BENCH.
@@ -152,6 +160,10 @@ class Fuzzer:
         self._h_signal_fold = reg.histogram(
             "signal_fold_seconds",
             help="host fold of a device batch's signal into the mirror")
+        self._g_drain_occupancy = reg.gauge(
+            "device_drain_env_occupancy",
+            help="fraction of executor envs that ran rows in the last "
+                 "device-batch drain")
         # fuzzer_-prefixed: the manager owns the bare corpus_size gauge,
         # and in-process deployments share one registry.  Weakref-bound
         # and detached in close(): the registry outlives fuzzer
@@ -221,10 +233,15 @@ class Fuzzer:
     # ---- lifecycle ----
 
     def close(self) -> None:
+        if self._drain_pool is not None:
+            self._drain_pool.shutdown(wait=True)
+            self._drain_pool = None
         for e in self.envs:
             e.close()
         for g, fn in getattr(self, "_gauge_fns", ()):
             g.clear_fn(fn)
+        if self._device is not None:
+            self._device.close()
 
     def __enter__(self):
         return self
@@ -315,6 +332,18 @@ class Fuzzer:
 
     # ---- execution ----
 
+    def _record_exec(self, stat: str, origin: Provenance) -> None:
+        """The one locked update for execution accounting: every exec path
+        — the serial loop and the parallel drain workers alike — lands
+        here, so the wire-stat dict stays consistent under the fan-out.
+        The exec_* counters are initialized in __init__, hence the plain
+        ``+= 1`` (an unknown stat string is a bug worth a KeyError)."""
+        with self._stats_lock:
+            self.stats["exec_total"] += 1
+            self.stats[stat] += 1
+        self._m_exec_total.inc()
+        self._ledger.record_exec(origin.phase, origin.ops)
+
     def execute(self, p: Prog, stat: str = "exec_fuzz",
                 opts: Optional[ExecOpts] = None, pid: int = 0,
                 scan_new: bool = True,
@@ -336,12 +365,9 @@ class Fuzzer:
             else:
                 logf(0, "executing program %d:\n%s", pid, serialize(p))
         _, infos, failed, hanged = env.exec(opts, p)
-        self.stats["exec_total"] += 1
-        self.stats[stat] = self.stats.get(stat, 0) + 1
-        self._m_exec_total.inc()
         if origin is None:
             origin = Provenance(_STAT_PHASE.get(stat, stat))
-        self._ledger.record_exec(origin.phase, origin.ops)
+        self._record_exec(stat, origin)
         if failed or hanged or not scan_new:
             return infos
         # check per-call signal for novelty -> triage
@@ -525,54 +551,110 @@ class Fuzzer:
         with timed("device.batch_exec", self._h_device_batch):
             self._run_device_batch_inner(batch)
 
+    def _get_drain_pool(self):
+        if self._drain_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._drain_pool = ThreadPoolExecutor(
+                max_workers=len(self.envs),
+                thread_name_prefix="syztpu-drain")
+        return self._drain_pool
+
     def _run_device_batch_inner(self, batch) -> None:
-        opts = ExecOpts()
-        batch_sigs = []
-        for i in range(len(batch)):
-            origin = Provenance(_attr.PHASE_MUTATE,
-                                ops_from_mask(batch.op_mask(i)))
-            stream = batch.streams[i]
-            if stream is None:
-                p = batch.decode(i)
-                if p is None:
-                    continue
-                infos = self.execute(p, "exec_fuzz", origin=origin)
-                batch_sigs.append(sorted(
-                    {s for info in infos or () for s in info.signal}))
+        """Drain one device batch across ALL executor envs: one worker per
+        env pulls rows off a shared cursor (dynamic balancing — a row that
+        skips costs ~nothing, a row that executes costs an exec round
+        trip), so per-env serialization is preserved by construction while
+        the fleet drains in parallel.  Stat/ledger updates go through the
+        locked ``_record_exec`` helper; triage enqueue and corpus adds are
+        already thread-safe; the signal mirror is folded ONCE per batch,
+        on the calling thread, after the workers join."""
+        n = len(batch)
+        nworkers = max(min(len(self.envs), n), 1)
+        rows = iter(range(n))
+        rows_lock = threading.Lock()
+
+        def drain(env_idx: int):
+            sigs: List[List[int]] = []
+            done = 0
+            while True:
+                with rows_lock:
+                    row = next(rows, None)
+                if row is None:
+                    return sigs, done
+                sig = self._drain_row(batch, row, env_idx)
+                done += 1
+                if sig is not None:
+                    sigs.append(sig)
+
+        results = []
+        first_exc = None
+        with span("device.batch_drain"):
+            if nworkers == 1:
+                results.append(drain(0))
+            else:
+                pool = self._get_drain_pool()
+                # collect EVERY worker before propagating a failure: an
+                # early re-raise would leave stragglers draining rows in
+                # the background, and a retried step would then race a
+                # fresh drain against them on the same envs
+                for f in [pool.submit(drain, k) for k in range(nworkers)]:
+                    try:
+                        results.append(f.result())
+                    except BaseException as e:  # noqa: BLE001
+                        if first_exc is None:
+                            first_exc = e
+        self._g_drain_occupancy.set(
+            sum(1 for _, done in results if done) / max(len(self.envs), 1))
+        self._fold_batch_signal([s for sigs, _ in results for s in sigs])
+        if first_exc is not None:
+            raise first_exc
+
+    def _drain_row(self, batch, row: int,
+                   env_idx: int) -> Optional[List[int]]:
+        """Execute one batch row on env ``env_idx``; returns the row's
+        executed signal (fed to the per-batch mirror fold) or None when
+        the row was skipped/failed.  Runs on drain worker threads — only
+        thread-safe state may be touched (see _run_device_batch_inner)."""
+        origin = Provenance(_attr.PHASE_MUTATE,
+                            ops_from_mask(batch.op_mask(row)))
+        stream = batch.streams[row]
+        if stream is None:
+            p = batch.decode(row)
+            if p is None:
+                return None
+            # fallback rows take the regular execute() path on this
+            # worker's env (pid pins the env, keeping serialization)
+            infos = self.execute(p, "exec_fuzz", pid=env_idx,
+                                 origin=origin)
+            return sorted({s for info in infos or () for s in info.signal})
+        call_ids = batch.call_ids(row)
+        if len(call_ids) <= 1:
+            return None  # mutation emptied the program: nothing to run
+        if self.cfg.log_programs:
+            # crash attribution/repro parses these records from the
+            # console log — raw streams must log like execute() does
+            p = batch.decode(row)
+            if p is not None:
+                from ..utils.log import logf
+                logf(0, "executing program %d:\n%s", env_idx, serialize(p))
+        _, infos, failed, hanged = self.envs[env_idx].exec_raw(
+            ExecOpts(), stream, call_ids)
+        self._record_exec("exec_fuzz", origin)
+        if failed or hanged:
+            return None
+        decoded = None
+        for info in infos:
+            diff = self._signal_diff(info.signal)
+            if not diff:
                 continue
-            call_ids = batch.call_ids(i)
-            if len(call_ids) <= 1:
-                continue  # mutation emptied the program: nothing to run
-            if self.cfg.log_programs:
-                # crash attribution/repro parses these records from the
-                # console log — raw streams must log like execute() does
-                p = batch.decode(i)
-                if p is not None:
-                    from ..utils.log import logf
-                    logf(0, "executing program %d:\n%s", 0, serialize(p))
-            env = self.envs[0]
-            _, infos, failed, hanged = env.exec_raw(
-                opts, stream, call_ids)
-            self.stats["exec_total"] += 1
-            self.stats["exec_fuzz"] = self.stats.get("exec_fuzz", 0) + 1
-            self._m_exec_total.inc()
-            self._ledger.record_exec(origin.phase, origin.ops)
-            if failed or hanged:
-                continue
-            decoded = None
-            for info in infos:
-                diff = self._signal_diff(info.signal)
-                if not diff:
-                    continue
-                if decoded is None:
-                    decoded = batch.decode(i)
-                if decoded is not None and info.index < len(decoded.calls):
-                    self.queue.push_triage(TriageItem(
-                        prog=decoded.clone(), call_index=info.index,
-                        signal=diff, origin=origin))
-            batch_sigs.append(sorted(
-                {s for info in infos for s in info.signal}))
-        self._fold_batch_signal(batch_sigs)
+            if decoded is None:
+                decoded = batch.decode(row)
+            if decoded is not None and info.index < len(decoded.calls):
+                self.queue.push_triage(TriageItem(
+                    prog=decoded.clone(), call_index=info.index,
+                    signal=diff, origin=origin))
+        return sorted({s for info in infos for s in info.signal})
 
     # ---- the loop ----
 
@@ -656,10 +738,11 @@ class Fuzzer:
 
 
 class _DevicePipeline:
-    """Device-side candidate factory: keeps an encoded mirror of the corpus
-    and emits batches of device-mutated candidates, double-buffered so the
-    TPU mutates batch N+1 while the executor fleet runs batch N (SURVEY §7
-    hard part #3).
+    """Device-side candidate factory: keeps the encoded corpus RESIDENT on
+    device (ops/arena.CorpusArena — append-once ring tensors, sampled with
+    jnp.take inside the sharded step) and emits batches of device-mutated
+    candidates, double-buffered so the TPU mutates batch N+1 while the
+    executor fleet runs batch N (SURVEY §7 hard part #3).
 
     The mutate/fingerprint/new-signal step is the SHARDED mesh step
     (parallel/mesh.make_fuzz_step) over every visible device — data
@@ -676,6 +759,7 @@ class _DevicePipeline:
         import numpy as np
 
         from ..descriptions.tables import get_tables
+        from ..ops.arena import CorpusArena
         from ..ops.dtables import build_device_tables
         from ..parallel import mesh as pmesh
         from ..prog.execgen import ExecGen
@@ -693,7 +777,7 @@ class _DevicePipeline:
         self.n_fuzz, self.n_cover = self.mesh.devices.shape
         # batch must divide the fuzz axis; round up
         self.B = -(-cfg.device_batch // self.n_fuzz) * self.n_fuzz
-        self._step, self._shardings = pmesh.make_fuzz_step(
+        self._step, self._shardings = pmesh.make_arena_fuzz_step(
             self.mesh, self.dt)
         # the sharded bitset mapping requires power-of-two total bits
         # (parallel/mesh._shard_index); round up like the host mirror does
@@ -705,7 +789,11 @@ class _DevicePipeline:
         self._pick = np.random.default_rng(1)
         self._pending = None  # in-flight device computation (double buffer)
         self.target = target
-        self._corpus_encoded: List = []
+        # device-resident encoded corpus: programs are encoded once on
+        # add_corpus and stay on the chips; the launch path samples rows
+        # on device, so there is no per-launch host re-stacking
+        self.arena = CorpusArena(max(int(cfg.arena_capacity), 1), self.fmt,
+                                 sharding=self._shardings["arena"])
 
         # device-health gauges (ISSUE 2): read-on-demand callbacks, so a
         # /metrics or sampler tick always sees live state.  Buffer bytes
@@ -726,31 +814,33 @@ class _DevicePipeline:
             help="bytes of live device arrays (jax.live_arrays)"
         ).set_fn(_live_bytes)
 
+    def close(self) -> None:
+        self.arena.close()
+
     def add_corpus(self, p: Prog) -> None:
         batch = self._ProgBatch.empty(self.fmt, 1)
         try:
             self._encode_prog(self.tables, self.fmt, p, batch, 0)
         except Exception:
             return  # long-tail arg the tensor format can't carry yet
-        self._corpus_encoded.append(
-            (batch.call_id[0], batch.slot_val[0], batch.data[0]))
+        self.arena.append(batch.call_id[0], batch.slot_val[0],
+                          batch.data[0])
 
     def _launch(self):
-        import numpy as np
-
         jax = self._jax
-        n = len(self._corpus_encoded)
-        if n == 0:
+        idx = self.arena.sample_indices(self._pick, self.B)
+        if idx is None:
             return None
-        self._key, kmut = jax.random.split(self._key)
-        idx = self._pick.integers(0, n, size=self.B)
-        cid = np.stack([self._corpus_encoded[i][0] for i in idx])
-        sval = np.stack([self._corpus_encoded[i][1] for i in idx])
-        data = np.stack([self._corpus_encoded[i][2] for i in idx])
-        sb = self._shardings["batch"]
-        cid, sval, data = (jax.device_put(x, sb) for x in (cid, sval, data))
-        cid, sval, data, self._sig_shard, fresh, op_mask = self._step(
-            kmut, cid, sval, data, self._sig_shard)
+        # the selection indices ([B] int32) are the ONLY per-launch H2D
+        # transfer: the batch is gathered out of the resident arena with
+        # jnp.take inside the jitted sharded step, and the signal bitset
+        # updates in place (donated)
+        with span("device.batch_stage"):
+            self._key, kmut = jax.random.split(self._key)
+            idx = jax.device_put(idx, self._shardings["batch"])
+            a_cid, a_sval, a_data = self.arena.tensors()
+            cid, sval, data, self._sig_shard, fresh, op_mask = self._step(
+                kmut, idx, a_cid, a_sval, a_data, self._sig_shard)
         return cid, sval, data, fresh, op_mask
 
     def candidates(self, corpus: List[Prog]) -> Optional["_DeviceBatch"]:
@@ -788,12 +878,28 @@ class _DeviceBatch:
 
     def __init__(self, pipe: "_DevicePipeline", batch, streams,
                  dropped: int = 0, op_masks=None):
+        import numpy as np
+
         self.pipe = pipe
         self.batch = batch
         self.streams = streams
         self.dropped = dropped  # stale rows gated off on device
         self.op_masks = op_masks  # [B] u32 per-row operator provenance
         self._decoded: Dict[int, Optional[Prog]] = {}
+        # per-row stream call ids, vectorized once for the whole batch:
+        # one numpy mask + one C-level tolist over [B, C] instead of a
+        # per-row per-int Python conversion loop (built eagerly so the
+        # parallel drain workers read an immutable list)
+        cid = np.asarray(batch.call_id)
+        live = cid >= 0
+        flat = cid[live].tolist()
+        mm = pipe.target.mmap_syscall.id
+        rows: List[List[int]] = []
+        start = 0
+        for end in np.cumsum(live.sum(axis=1)).tolist():
+            rows.append([mm] + flat[start:end])
+            start = end
+        self._call_ids = rows
 
     def __len__(self) -> int:
         return len(self.streams)
@@ -807,13 +913,9 @@ class _DeviceBatch:
 
     def call_ids(self, row: int) -> List[int]:
         """Stream call ids: prelude mmap + the row's active calls (matches
-        both the emitted stream and the decoded Prog's call list)."""
-        t = self.pipe.target
-        ids = [t.mmap_syscall.id]
-        for cid in self.batch.call_id[row]:
-            if int(cid) >= 0:
-                ids.append(int(cid))
-        return ids
+        both the emitted stream and the decoded Prog's call list).
+        Precomputed for the whole batch in __init__."""
+        return self._call_ids[row]
 
     def decode(self, row: int) -> Optional[Prog]:
         if row in self._decoded:

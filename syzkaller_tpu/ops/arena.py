@@ -1,0 +1,166 @@
+"""Device-resident corpus arena: the encoded corpus lives on the chips.
+
+Before this module the host kept the encoded corpus as a Python list of
+per-program numpy triples and, on every device launch, re-``np.stack``-ed
+a [B, ...] batch out of it and ``device_put`` the result — an O(B) host
+walk plus a full-batch H2D transfer per launch, exactly on the boundary
+the paper optimizes (mutation/new-signal testing on the TPU, only syscall
+execution on the CPU fleet).  The arena replaces that with preallocated
+device tensors
+
+    cid  [cap, C]     int32    syscall id per call slot (-1 = empty)
+    sval [cap, C, S]  uint64   template slot values
+    data [cap, C, D]  uint8    per-call copyin arena image
+
+appended to by a jitted donated single-row ``.at[row].set`` (the only
+per-add transfer is the one encoded program) and sampled *inside* the
+sharded fuzz step with ``jnp.take`` (parallel/mesh.make_arena_fuzz_step)
+— so the only per-launch H2D transfer is the [B] int32 selection-index
+vector.  This is the memoization move from "Toward Speeding up Mutation
+Analysis by Memoizing Expensive Methods": encode once, stay resident.
+
+Eviction is a ring (FIFO overwrite): once ``size == capacity`` the cursor
+wraps and the oldest encoded program is overwritten, so week-long
+campaigns stay memory-bounded.  Occupancy / evictions / resident bytes
+are exported as the ``arena_*`` gauge family (tools/check_metrics.py
+requires them to stay registered).
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Optional, Tuple
+
+from . import ensure_x64  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..telemetry import get_registry
+
+
+def _append_row(a_cid, a_sval, a_data, row, cid, sval, data):
+    """Jitted single-row write; the arena tensors are donated so XLA
+    updates them in place instead of copying [cap, ...] per append."""
+    return (a_cid.at[row].set(cid),
+            a_sval.at[row].set(sval),
+            a_data.at[row].set(data))
+
+
+class CorpusArena:
+    """Ring of encoded corpus programs resident on device.
+
+    Concurrency contract: ``append`` serializes writers under one lock,
+    and because it DONATES the previous tensors (the in-place update is
+    the point), the pre-append triple is consumed — a reader must not
+    cache ``tensors()`` results across an append.  ``gather`` therefore
+    dispatches its take under the lock.  The engine complies by
+    construction: appends and launches both happen on the scheduling
+    thread (drain workers never touch the arena), and a launch already
+    enqueued holds runtime-level buffer references, so an append cannot
+    invalidate in-flight device work.
+    """
+
+    def __init__(self, capacity: int, fmt, sharding=None,
+                 registry=None):
+        cap = int(capacity)
+        if cap <= 0:
+            raise ValueError(f"arena capacity must be positive, got {cap}")
+        self.capacity = cap
+        self.size = 0          # rows holding a real program
+        self.cursor = 0        # next row to write (ring)
+        self.evictions = 0     # overwrites of live rows
+        cid = jnp.full((cap, fmt.max_calls), -1, jnp.int32)
+        sval = jnp.zeros((cap, fmt.max_calls, fmt.max_slots), jnp.uint64)
+        data = jnp.zeros((cap, fmt.max_calls, fmt.arena), jnp.uint8)
+        if sharding is not None:
+            cid, sval, data = (jax.device_put(x, sharding)
+                               for x in (cid, sval, data))
+        self.cid, self.sval, self.data = cid, sval, data
+        self._lock = threading.Lock()
+        self._append_fn = jax.jit(_append_row, donate_argnums=(0, 1, 2))
+
+        reg = registry or get_registry()
+        self._c_evictions = reg.counter(
+            "arena_evictions_total",
+            help="corpus-arena ring overwrites of live rows")
+        ref = weakref.ref(self)
+        self._gauge_fns = [
+            (reg.gauge(
+                "arena_occupancy",
+                help="fraction of corpus-arena rows holding a program"),
+             lambda: (a.size / a.capacity)
+             if (a := ref()) is not None else 0.0),
+            (reg.gauge(
+                "arena_resident_bytes",
+                help="bytes of device-resident encoded corpus tensors"),
+             lambda: a.resident_bytes() if (a := ref()) is not None else 0),
+        ]
+        for g, fn in self._gauge_fns:
+            g.set_fn(fn)
+
+    # ---- lifecycle ----
+
+    def close(self) -> None:
+        for g, fn in getattr(self, "_gauge_fns", ()):
+            g.clear_fn(fn)
+
+    def resident_bytes(self) -> int:
+        return sum(int(getattr(x, "nbytes", 0))
+                   for x in (self.cid, self.sval, self.data))
+
+    def __len__(self) -> int:
+        return self.size
+
+    # ---- writes ----
+
+    def append(self, cid_row, sval_row, data_row) -> int:
+        """Write one encoded program into the next ring slot; returns the
+        row index.  The H2D payload is the single row, the [cap, ...]
+        tensors update in place (donated)."""
+        with self._lock:
+            row = self.cursor
+            self.cursor = (self.cursor + 1) % self.capacity
+            if self.size == self.capacity:
+                self.evictions += 1
+                self._c_evictions.inc()
+            else:
+                self.size += 1
+            self.cid, self.sval, self.data = self._append_fn(
+                self.cid, self.sval, self.data, row,
+                jnp.asarray(np.asarray(cid_row), jnp.int32),
+                jnp.asarray(np.asarray(sval_row), jnp.uint64),
+                jnp.asarray(np.asarray(data_row), jnp.uint8))
+            return row
+
+    # ---- reads ----
+
+    def tensors(self) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """The live (cid, sval, data) triple.  Use immediately: a later
+        ``append`` donates (consumes) these buffers — see the class
+        concurrency contract."""
+        with self._lock:
+            return self.cid, self.sval, self.data
+
+    def gather(self, idx) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+        """Device-side row gather (tests + host tooling; the production
+        launch path gathers inside the sharded fuzz step instead).
+        Dispatched under the lock so a concurrent append cannot donate
+        the triple out from under the take."""
+        idx = jnp.asarray(np.asarray(idx), jnp.int32)
+        with self._lock:
+            return (jnp.take(self.cid, idx, axis=0),
+                    jnp.take(self.sval, idx, axis=0),
+                    jnp.take(self.data, idx, axis=0))
+
+    def sample_indices(self, rng: np.random.Generator, n: int,
+                       ) -> Optional[np.ndarray]:
+        """Uniform row indices over the live region ([B] int32 — the only
+        per-launch H2D transfer); None while the arena is empty."""
+        with self._lock:
+            size = self.size
+        if size == 0:
+            return None
+        return np.asarray(rng.integers(0, size, size=n), np.int32)

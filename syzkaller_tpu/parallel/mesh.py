@@ -234,7 +234,8 @@ def _step_body(dt: DeviceTables, rounds: int, key, cid, sval, data,
     return cid, sval, data, sig_shard, fresh, op_mask
 
 
-def make_fuzz_step(mesh: Mesh, dt: DeviceTables, *, rounds: int = 2):
+def make_fuzz_step(mesh: Mesh, dt: DeviceTables, *, rounds: int = 2,
+                   donate: bool = True):
     """Compile the full sharded fuzz step over `mesh`.
 
     Returns (step, sharding) where
@@ -244,7 +245,13 @@ def make_fuzz_step(mesh: Mesh, dt: DeviceTables, *, rounds: int = 2):
     fuzz axis), sig_shard is the full bitset sharded over ``cover`` (word
     count must divide the cover axis), key is replicated.  ``op_mask``
     [B] u32 carries per-lane mutation-operator provenance (bit i set iff
-    operator i touched the lane) for the attribution ledger."""
+    operator i touched the lane) for the attribution ledger.
+
+    With ``donate`` (the default) the batch tensors and the signal bitset
+    are donated, so the double-buffered engine loop updates its shards in
+    place instead of allocating fresh [B, ...] + bitset buffers every
+    round — the inputs are INVALID after the call; pass ``donate=False``
+    when the caller must reuse them (parity tests)."""
     pspec_batch = P(AXIS_FUZZ)
     pspec_sig = P(AXIS_COVER)
 
@@ -254,11 +261,64 @@ def make_fuzz_step(mesh: Mesh, dt: DeviceTables, *, rounds: int = 2):
         in_specs=(P(), pspec_batch, pspec_batch, pspec_batch, pspec_sig),
         out_specs=(pspec_batch, pspec_batch, pspec_batch, pspec_sig,
                    pspec_batch, pspec_batch))
-    step = _timed_step(jax.jit(mapped), "device.fuzz_step")
+    jitted = jax.jit(mapped, donate_argnums=(1, 2, 3, 4) if donate else ())
+    step = _timed_step(jitted, "device.fuzz_step")
     shardings = {
         "batch": NamedSharding(mesh, pspec_batch),
         "signal": NamedSharding(mesh, pspec_sig),
         "replicated": NamedSharding(mesh, P()),
+    }
+    return step, shardings
+
+
+def _arena_step_body(dt: DeviceTables, rounds: int, key, idx, a_cid,
+                     a_sval, a_data, sig_shard):
+    """Per-device body for the arena-resident launch path: gather my
+    candidate shard out of the replicated corpus arena with ``jnp.take``,
+    then mutate / fingerprint / fold exactly like ``_step_body``.  The
+    host ships only ``idx`` — the [B] selection vector — per launch."""
+    i = jax.lax.axis_index(AXIS_FUZZ)
+    j = jax.lax.axis_index(AXIS_COVER)
+    key = jax.random.fold_in(jax.random.fold_in(key, i), j)
+    cid = jnp.take(a_cid, idx, axis=0)
+    sval = jnp.take(a_sval, idx, axis=0)
+    data = jnp.take(a_data, idx, axis=0)
+    cid, sval, data, op_mask = dmut.mutate_rows_stratified_traced(
+        key, dt, cid, sval, data, rounds)
+    sigs = jax.vmap(call_fingerprints)(cid, sval)      # [b, C] u32
+    sig_shard, fresh = fold_signals(sig_shard, sigs)
+    return cid, sval, data, sig_shard, fresh, op_mask
+
+
+def make_arena_fuzz_step(mesh: Mesh, dt: DeviceTables, *, rounds: int = 2,
+                         donate: bool = True):
+    """Compile the arena-sampling sharded fuzz step over `mesh`.
+
+    Returns (step, sharding) where
+      step(key, idx, arena_cid, arena_sval, arena_data, sig_shard)
+        -> (cid, sval, data, sig_shard, fresh, op_mask)
+    ``idx`` [B] int32 is batch-sharded over ``fuzz`` and is the only
+    per-launch host->device transfer; the arena tensors ([cap, ...],
+    ops/arena.CorpusArena) are replicated and sampled on device inside
+    the jitted step.  The signal bitset is donated (``donate``) so the
+    steady-state loop reuses one buffer; the arena tensors are NOT
+    donated — they persist across launches by design."""
+    pspec_batch = P(AXIS_FUZZ)
+    pspec_sig = P(AXIS_COVER)
+
+    body = partial(_arena_step_body, dt, rounds)
+    mapped = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), pspec_batch, P(), P(), P(), pspec_sig),
+        out_specs=(pspec_batch, pspec_batch, pspec_batch, pspec_sig,
+                   pspec_batch, pspec_batch))
+    jitted = jax.jit(mapped, donate_argnums=(5,) if donate else ())
+    step = _timed_step(jitted, "device.fuzz_step")
+    shardings = {
+        "batch": NamedSharding(mesh, pspec_batch),
+        "signal": NamedSharding(mesh, pspec_sig),
+        "replicated": NamedSharding(mesh, P()),
+        "arena": NamedSharding(mesh, P()),
     }
     return step, shardings
 

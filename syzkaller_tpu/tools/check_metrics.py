@@ -13,9 +13,14 @@ fails on:
 
 Dynamically built names (``"fleet_" + k``, the tracer's ``span_*``
 histograms) are exempt by construction: only string-literal first
-arguments are checked.  Invoked from the test suite (tests/test_analytics
-.py) so the namespace stays coherent as it grows; also runnable as
-``python -m syzkaller_tpu.tools.check_metrics``.
+arguments are checked.  REQUIRED_METRICS additionally pins names that
+must never lose their registration (the ``arena_*`` corpus-arena family,
+the drain/device-health gauges) — dropping one breaks dashboards and
+capacity tuning silently.  Invoked from the test suite
+(tests/test_analytics.py) so the namespace stays coherent as it grows;
+also runnable as ``python -m syzkaller_tpu.tools.check_metrics``
+(``--require name1,name2`` overrides the pinned set; a trailing ``*``
+matches a prefix family).
 """
 
 from __future__ import annotations
@@ -28,6 +33,24 @@ from typing import Dict, List, NamedTuple
 
 SNAKE_CASE = re.compile(r"^[a-z][a-z0-9_]*$")
 METRIC_METHODS = ("counter", "gauge", "histogram")
+
+# Metrics the package must keep registered (a refactor that silently drops
+# one breaks dashboards and the BENCH JSON schema).  A trailing ``*``
+# requires at least one registration with that prefix.  Applied when
+# linting the package itself (default root); explicit roots — the unit
+# tests' tmp trees — are exempt unless the caller passes ``required``.
+REQUIRED_METRICS = (
+    # device-resident corpus arena (ISSUE 3): occupancy / evictions /
+    # resident bytes must stay visible for capacity tuning
+    "arena_occupancy",
+    "arena_evictions_total",
+    "arena_resident_bytes",
+    # parallel executor fan-out: env utilization of the batch drain
+    "device_drain_env_occupancy",
+    # device health family (ISSUE 2)
+    "device_batch_occupancy",
+    "device_live_buffer_bytes",
+)
 
 
 class Registration(NamedTuple):
@@ -76,10 +99,36 @@ def collect_registrations(root: str = "") -> List[Registration]:
     return regs
 
 
-def check(root: str = "") -> List[str]:
+def check(root: str = "", required=None) -> List[str]:
     """Lint the package's metric namespace; returns problem strings
-    (empty list == clean)."""
-    return _problems(collect_registrations(root))
+    (empty list == clean).  ``required`` overrides the REQUIRED_METRICS
+    presence check (defaulted for the package root, off for explicit
+    roots so test fixtures lint standalone)."""
+    return _lint(collect_registrations(root), root, required)
+
+
+def _lint(regs: List[Registration], root: str, required) -> List[str]:
+    """The one lint core (check() and main() share it — one walk, one
+    required-defaulting rule)."""
+    if required is None:
+        required = REQUIRED_METRICS if not root else ()
+    return _problems(regs) + _missing_required(regs, required)
+
+
+def _missing_required(regs: List[Registration], required) -> List[str]:
+    names = {r.name for r in regs}
+    problems: List[str] = []
+    for req in required:
+        if req.endswith("*"):
+            if not any(n.startswith(req[:-1]) for n in names):
+                problems.append(
+                    f"required metric family {req!r} has no literal "
+                    f"registration anywhere in the package")
+        elif req not in names:
+            problems.append(
+                f"required metric {req!r} is not registered anywhere "
+                f"in the package")
+    return problems
 
 
 def _problems(regs: List[Registration]) -> List[str]:
@@ -100,10 +149,19 @@ def _problems(regs: List[Registration]) -> List[str]:
 
 
 def main(argv=None) -> int:
-    args = argv if argv is not None else sys.argv[1:]
+    args = list(argv) if argv is not None else sys.argv[1:]
+    required = None
+    if "--require" in args:
+        i = args.index("--require")
+        if i + 1 >= len(args):
+            print("usage: check_metrics [root] [--require name1,name2]",
+                  file=sys.stderr)
+            return 2
+        required = tuple(x for x in args[i + 1].split(",") if x)
+        del args[i:i + 2]
     root = args[0] if args else ""
     regs = collect_registrations(root)
-    problems = _problems(regs)
+    problems = _lint(regs, root, required)
     for p in problems:
         print(p, file=sys.stderr)
     print(f"check_metrics: {len(regs)} literal registrations, "

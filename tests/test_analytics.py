@@ -325,6 +325,36 @@ def test_metric_namespace_is_coherent():
     names = {r.name for r in regs}
     assert {"exec_total", "corpus_size",
             "device_batch_occupancy"} <= names
+    # the arena + drain families (ISSUE 3) are registered and documented
+    assert {"arena_occupancy", "arena_evictions_total",
+            "arena_resident_bytes", "device_drain_env_occupancy"} <= names
+    assert check() == []
+
+
+def test_check_metrics_required_metrics(tmp_path):
+    """The linter fails when a REQUIRED metric (the arena_* family and
+    the drain gauge) loses its registration — a refactor must not drop
+    them silently."""
+    from syzkaller_tpu.tools.check_metrics import (
+        REQUIRED_METRICS,
+        check,
+        main,
+    )
+
+    assert "arena_occupancy" in REQUIRED_METRICS
+    assert "arena_evictions_total" in REQUIRED_METRICS
+    assert "arena_resident_bytes" in REQUIRED_METRICS
+    # a tree without the arena registrations fails the required check...
+    stub = tmp_path / "stub.py"
+    stub.write_text("reg.counter('other_total', help='x')\n")
+    problems = check(str(tmp_path), required=("arena_occupancy",
+                                              "device_drain_*"))
+    assert any("arena_occupancy" in p for p in problems)
+    assert any("device_drain_*" in p for p in problems)
+    assert main([str(tmp_path), "--require", "arena_occupancy"]) == 1
+    # ...and explicit roots without `required` stay exempt (fixtures)
+    assert check(str(tmp_path)) == []
+    # the real package satisfies the full required set
     assert check() == []
 
 

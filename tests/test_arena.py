@@ -1,0 +1,136 @@
+"""Device-resident corpus arena tests (ISSUE 3 tentpole): append/sample
+parity with the old stack-and-put path, ring eviction bounds, arena_*
+gauges, and the guard that the launch path stages no O(B) host batch."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from syzkaller_tpu.descriptions.tables import get_tables  # noqa: E402
+from syzkaller_tpu.engine.fuzzer import Fuzzer, FuzzerConfig  # noqa: E402
+from syzkaller_tpu.ops.arena import CorpusArena  # noqa: E402
+from syzkaller_tpu.prog import get_target  # noqa: E402
+from syzkaller_tpu.prog.generation import generate  # noqa: E402
+from syzkaller_tpu.prog.tensor import (  # noqa: E402
+    ProgBatch,
+    TensorFormat,
+    encode_prog,
+)
+from syzkaller_tpu.telemetry.metrics import Registry  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def env():
+    target = get_target("linux", "amd64")
+    tables = get_tables(target)
+    fmt = TensorFormat.for_tables(tables, max_calls=8)
+    return target, tables, fmt
+
+
+def _encode_rows(target, tables, fmt, n, seed=0):
+    """n encoded (cid, sval, data) triples, skipping codec long-tail."""
+    rows = []
+    while len(rows) < n:
+        p = generate(target, seed, 6)
+        seed += 1
+        b = ProgBatch.empty(fmt, 1)
+        try:
+            encode_prog(tables, fmt, p, b, 0)
+        except Exception:
+            continue
+        rows.append((b.call_id[0].copy(), b.slot_val[0].copy(),
+                     b.data[0].copy()))
+    return rows
+
+
+def test_append_gather_matches_stack_and_put(env):
+    """Round-trip parity: sampling the arena on device equals the old
+    host np.stack-then-device_put path bit-for-bit."""
+    target, tables, fmt = env
+    rows = _encode_rows(target, tables, fmt, 12)
+    arena = CorpusArena(16, fmt, registry=Registry())
+    for cid, sval, data in rows:
+        arena.append(cid, sval, data)
+    assert arena.size == 12 and arena.evictions == 0
+
+    idx = arena.sample_indices(np.random.default_rng(5), 32)
+    assert idx is not None and idx.dtype == np.int32
+    assert int(idx.max()) < 12 and int(idx.min()) >= 0
+    g_cid, g_sval, g_data = (np.asarray(x) for x in arena.gather(idx))
+    np.testing.assert_array_equal(
+        g_cid, np.stack([rows[i][0] for i in idx]))
+    np.testing.assert_array_equal(
+        g_sval, np.stack([rows[i][1] for i in idx]))
+    np.testing.assert_array_equal(
+        g_data, np.stack([rows[i][2] for i in idx]))
+
+
+def test_ring_eviction_bounds_capacity(env):
+    """Long campaigns stay bounded: the ring overwrites the oldest rows
+    and the eviction counter records every overwrite."""
+    target, tables, fmt = env
+    rows = _encode_rows(target, tables, fmt, 10)
+    reg = Registry()
+    arena = CorpusArena(4, fmt, registry=reg)
+    for cid, sval, data in rows:
+        arena.append(cid, sval, data)
+    assert arena.size == 4
+    assert arena.evictions == 6
+    assert arena.cursor == 10 % 4
+    assert reg.snapshot()["arena_evictions_total"] == 6
+    # appends 0..9 land on slots 0,1,2,3,0,1,2,3,0,1 — the ring holds the
+    # newest four, in wrap order
+    a_cid, a_sval, a_data = (np.asarray(x) for x in arena.tensors())
+    for slot, ridx in {0: 8, 1: 9, 2: 6, 3: 7}.items():
+        np.testing.assert_array_equal(a_cid[slot], rows[ridx][0])
+        np.testing.assert_array_equal(a_sval[slot], rows[ridx][1])
+        np.testing.assert_array_equal(a_data[slot], rows[ridx][2])
+
+
+def test_arena_gauges(env):
+    target, tables, fmt = env
+    reg = Registry()
+    arena = CorpusArena(8, fmt, registry=reg)
+    rows = _encode_rows(target, tables, fmt, 2)
+    for cid, sval, data in rows:
+        arena.append(cid, sval, data)
+    snap = reg.snapshot()
+    assert snap["arena_occupancy"] == pytest.approx(2 / 8)
+    assert snap["arena_resident_bytes"] == arena.resident_bytes() > 0
+    assert snap["arena_evictions_total"] == 0
+    assert arena.sample_indices(np.random.default_rng(0), 4) is not None
+    # an empty arena refuses to sample
+    empty = CorpusArena(8, fmt, registry=Registry())
+    assert empty.sample_indices(np.random.default_rng(0), 4) is None
+
+
+def test_launch_path_has_no_host_stack(env, monkeypatch):
+    """Guard (ISSUE 3 acceptance): the steady-state launch path is an
+    O(B) device-side gather — no per-row host np.stack staging, and no
+    encoded-corpus host list to re-stack from."""
+    target, _, _ = env
+    cfg = FuzzerConfig(mock=True, use_device=True, device_batch=8,
+                       program_length=8, smash_mutations=1,
+                       device_period=2, arena_capacity=32)
+    with Fuzzer(target, cfg) as f:
+        assert f._device is not None
+        # the host-side encoded-corpus list is gone entirely
+        assert not hasattr(f._device, "_corpus_encoded")
+        for _ in range(200):
+            f.step()
+            if f._device.arena.size >= 1 and len(f.corpus) >= 1:
+                break
+        assert f._device.arena.size >= 1
+
+        def boom(*a, **k):
+            raise AssertionError(
+                "np.stack on the launch path — O(B) host staging is back")
+
+        monkeypatch.setattr(np, "stack", boom)
+        before = f.stats["device_batches"]
+        for _ in range(400):
+            f.step()
+            if f.stats["device_batches"] > before:
+                break
+        assert f.stats["device_batches"] > before

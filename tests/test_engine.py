@@ -2,6 +2,8 @@
 (when the toolchain allows) the real executor."""
 
 import shutil
+import threading
+import time
 
 import pytest
 
@@ -153,6 +155,124 @@ def test_device_hints_join_in_smash(target):
         assert f.stats.get("hints_device_joins", 0) > 0
         # joins produced actual executed mutants (beyond the seed exec)
         assert f.stats["exec_hints"] > f.stats["hints_device_joins"]
+
+
+class _CountingEnv:
+    """Fake executor env for the drain fan-out: counts exec_raw calls,
+    detects concurrent entry (per-env serialization must hold), and
+    sleeps long enough that the pool provably overlaps workers."""
+
+    def __init__(self):
+        self.execs = 0
+        self.overlapped = False
+        self._busy = threading.Lock()
+
+    def exec_raw(self, opts, data, call_ids):
+        if not self._busy.acquire(blocking=False):
+            self.overlapped = True
+            raise AssertionError("concurrent exec_raw on one env")
+        try:
+            time.sleep(0.002)
+            self.execs += 1
+            return b"", [], False, False
+        finally:
+            self._busy.release()
+
+    def close(self):
+        pass
+
+
+class _FakeBatch:
+    """Minimal _DeviceBatch stand-in: every row is a raw stream."""
+
+    def __init__(self, n):
+        self.streams = [b"\x00"] * n
+        self.dropped = 0
+
+    def __len__(self):
+        return len(self.streams)
+
+    def op_mask(self, row):
+        return 1
+
+    def call_ids(self, row):
+        return [0, 1]  # prelude mmap + one live call: row executes
+
+    def decode(self, row):
+        return None
+
+
+def test_parallel_drain_fans_out_across_envs(target):
+    """One device batch drains across ALL envs: rows are dynamically
+    balanced over one worker per env, per-env serialization holds, and
+    every stat lands exactly once through the locked helper."""
+    with mk(target, procs=4) as f:
+        envs = [_CountingEnv() for _ in range(4)]
+        f.envs = envs
+        before_fuzz = f.stats["exec_fuzz"]
+        before_total = f.stats["exec_total"]
+        f._run_device_batch_inner(_FakeBatch(40))
+        assert sum(e.execs for e in envs) == 40
+        assert not any(e.overlapped for e in envs)
+        # dynamic row-pull with a 2ms exec: every worker gets rows
+        assert sum(1 for e in envs if e.execs) >= 3
+        assert f.stats["exec_fuzz"] == before_fuzz + 40
+        assert f.stats["exec_total"] == before_total + 40
+        occ = f.metrics.get("device_drain_env_occupancy")
+        assert occ is not None and occ.value >= 0.75
+
+
+def test_parallel_drain_single_env_inline(target):
+    """procs=1 drains inline (no pool), same accounting."""
+    with mk(target) as f:
+        env = _CountingEnv()
+        f.envs = [env]
+        f._run_device_batch_inner(_FakeBatch(5))
+        assert env.execs == 5
+        assert f.stats["exec_fuzz"] == 5
+        assert f._drain_pool is None  # never built for one env
+
+
+def test_device_drain_multiproc_integration(target):
+    """End-to-end: the device pipeline's batches drain across a 3-env
+    mock fleet and the exec stat ledger stays exactly consistent (every
+    exec recorded once despite the worker threads)."""
+    pytest.importorskip("jax")
+    cfg = FuzzerConfig(mock=True, use_device=True, device_batch=16,
+                       program_length=8, smash_mutations=1,
+                       device_period=4, procs=3)
+    with Fuzzer(target, cfg) as f:
+        for _ in range(600):
+            f.step()
+            if f.stats["device_candidates"] >= 16:
+                break
+        assert f.stats["device_candidates"] >= 16
+        parts = ("exec_gen", "exec_fuzz", "exec_candidate", "exec_triage",
+                 "exec_minimize", "exec_smash", "exec_hints")
+        assert f.stats["exec_total"] == sum(f.stats[k] for k in parts)
+
+
+def test_batch_call_ids_vectorized_parity(target):
+    """The batch-vectorized call_ids equals the per-row walk it
+    replaced (prelude mmap + live calls, in slot order)."""
+    pytest.importorskip("jax")
+    cfg = FuzzerConfig(mock=True, use_device=True, device_batch=8,
+                       program_length=8, smash_mutations=1,
+                       device_period=2)
+    with Fuzzer(target, cfg) as f:
+        batch = None
+        for _ in range(200):
+            f.step()
+            if f.corpus:
+                batch = f._device.candidates(f.corpus)
+                if batch is not None and len(batch):
+                    break
+        assert batch is not None and len(batch)
+        mm = target.mmap_syscall.id
+        for row in range(len(batch)):
+            expect = [mm] + [int(c) for c in batch.batch.call_id[row]
+                             if int(c) >= 0]
+            assert batch.call_ids(row) == expect
 
 
 def test_device_pipeline_runs_sharded_mesh_step(target):

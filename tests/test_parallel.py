@@ -59,9 +59,13 @@ def test_sharded_fuzz_step(env):
     gen = pmesh.make_generate_step(m, dt, C=C)
     key = jax.random.PRNGKey(7)
     cid, sval, data = gen(key, jnp.zeros((B,), jnp.int32))
+    # the step donates its batch + signal inputs (in-place update on the
+    # double-buffered loop): keep host copies for the re-fold below
+    cid0, sval0, data0 = (np.asarray(x).copy() for x in (cid, sval, data))
 
-    step, _ = pmesh.make_fuzz_step(m, dt)
-    sig = jnp.zeros(NBITS // 32, jnp.uint32)
+    step, shardings = pmesh.make_fuzz_step(m, dt)
+    sig = jax.device_put(jnp.zeros(NBITS // 32, jnp.uint32),
+                         shardings["signal"])
     cid2, sval2, data2, sig2, fresh, opm = step(key, cid, sval, data, sig)
 
     # shapes preserved, signal set grew, first step sees fresh signal
@@ -81,13 +85,63 @@ def test_sharded_fuzz_step(env):
     for p in decode_batch(tables, fmt, batch):
         p.validate()
 
+    # donation: the first call consumed its batch/signal inputs in place
+    for donated in (cid, sval, data, sig):
+        assert donated.is_deleted()
+
     # running the same batch again: no fresh signal (set is saturated
     # w.r.t. these fingerprints) unless mutation changed programs -- so
     # instead re-fold the *same* signals via a second identical step with
     # mutation disabled is not exposed; check determinism of fold instead:
-    _, _, _, sig3, fresh3, _ = step(key, cid, sval, data, sig2)
-    np.testing.assert_array_equal(np.asarray(sig3), np.asarray(sig2) |
-                                  np.asarray(sig3))
+    sig2_host = np.asarray(sig2).copy()  # sig2 is donated next call
+    _, _, _, sig3, fresh3, _ = step(key, jnp.asarray(cid0),
+                                    jnp.asarray(sval0), jnp.asarray(data0),
+                                    sig2)
+    np.testing.assert_array_equal(np.asarray(sig3),
+                                  sig2_host | np.asarray(sig3))
+
+
+def test_arena_fuzz_step(env):
+    """The arena-sampling sharded step: the corpus stays resident and
+    replicated, only the [B] index vector crosses per launch, the batch
+    materializes on device via jnp.take, and the signal bitset is donated
+    while the arena tensors are NOT (they persist across launches)."""
+    target, tables, fmt, dt, m = env
+    B, C = 16, fmt.max_calls
+    gen = pmesh.make_generate_step(m, dt, C=C)
+    key = jax.random.PRNGKey(11)
+    cap = 8
+    a_cid, a_sval, a_data = gen(key, jnp.zeros((cap,), jnp.int32))
+    a_cid, a_sval, a_data = (
+        jax.device_put(x, jax.sharding.NamedSharding(
+            m, jax.sharding.PartitionSpec()))
+        for x in (a_cid, a_sval, a_data))
+
+    step, shardings = pmesh.make_arena_fuzz_step(m, dt)
+    assert "arena" in shardings
+    idx = jnp.asarray(np.random.default_rng(3).integers(
+        0, cap, size=B), jnp.int32)
+    sig = jax.device_put(jnp.zeros(NBITS // 32, jnp.uint32),
+                         shardings["signal"])
+    cid, sval, data, sig2, fresh, opm = step(
+        key, idx, a_cid, a_sval, a_data, sig)
+    assert cid.shape == (B, C)
+    assert sval.shape == (B, C, dt.max_slots)
+    assert opm.shape == (B,) and bool(jnp.all(opm > 0))
+    assert int(jnp.sum(jax.lax.population_count(sig2))) > 0
+    assert bool(jnp.any(fresh))
+    # signal donated, arena persists for the next launch
+    assert sig.is_deleted()
+    assert not a_cid.is_deleted()
+    assert not a_sval.is_deleted()
+    assert not a_data.is_deleted()
+    # mutated lanes gathered from the arena still decode + validate
+    batch = ProgBatch(np.asarray(cid), np.asarray(sval), np.asarray(data))
+    for p in decode_batch(tables, fmt, batch):
+        p.validate()
+    # and the step is re-launchable against the updated signal state
+    out = step(key, idx, a_cid, a_sval, a_data, sig2)
+    jax.block_until_ready(out)
 
 
 def test_fingerprints_mask_dead_calls(env):
