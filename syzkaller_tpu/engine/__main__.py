@@ -30,12 +30,23 @@ def main(argv=None) -> int:
     ap.add_argument("-iterations", type=int, default=0,
                     help="stop after N steps (0 = run forever)")
     ap.add_argument("-leak-check", action="store_true")
+    ap.add_argument("-workdir", default="",
+                    help="campaign working directory; enables periodic "
+                    "atomic checkpoints to <workdir>/engine.ckpt")
+    ap.add_argument("--resume", action="store_true",
+                    help="restore engine state from <workdir>/engine.ckpt "
+                    "(corrupt/missing checkpoints start fresh)")
+    ap.add_argument("-checkpoint-interval", type=float, default=60.0,
+                    help="seconds between periodic checkpoints")
     ap.add_argument("--telemetry-out", default="",
                     help="on exit, dump the telemetry document (metrics "
                     "snapshot + Chrome trace) to this JSON file")
     ap.add_argument("--no-spans", action="store_true",
                     help="disable span tracing (counters stay on)")
     args = ap.parse_args(argv)
+    if args.resume and not args.workdir:
+        ap.error("--resume requires -workdir (the checkpoint lives at "
+                 "<workdir>/engine.ckpt)")
 
     from ..prog import get_target
     from ..telemetry import set_spans_enabled, telemetry_dump_to
@@ -56,6 +67,9 @@ def main(argv=None) -> int:
         sandbox=args.sandbox,
         detect_supported=not args.no_detect and not args.mock,
         leak_check=args.leak_check,
+        workdir=args.workdir,
+        resume=args.resume,
+        checkpoint_interval=args.checkpoint_interval,
     )
     f = Fuzzer(target, cfg, manager=manager)
     try:
@@ -68,6 +82,12 @@ def main(argv=None) -> int:
     except KeyboardInterrupt:
         return 0
     finally:
+        # final checkpoint so a clean exit resumes exactly where it left
+        if args.workdir:
+            try:
+                f.maybe_checkpoint(force=True)
+            except Exception as e:
+                print(f"final checkpoint failed: {e}", file=sys.stderr)
         # dump before close(): close detaches the weakref-bound gauges,
         # which would zero fuzzer_corpus_size etc. in the document
         if args.telemetry_out:

@@ -19,9 +19,11 @@ Signal bookkeeping (fuzzer.go:65-68):
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import weakref
+from collections import deque
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Set
 
@@ -29,6 +31,7 @@ from ..ipc import CallInfo, Env, EnvConfig, ExecOpts, MockEnv
 from ..prog.analysis import assign_sizes_call
 from ..telemetry import (
     Provenance,
+    count_error,
     get_ledger,
     get_registry,
     ops_from_mask,
@@ -36,6 +39,7 @@ from ..telemetry import (
     timed,
 )
 from ..telemetry import attribution as _attr
+from ..testing import faults as _faults
 from ..prog.encoding import serialize
 from ..prog.generation import RandGen, generate
 from ..prog.hints import CompMap, mutate_with_hints
@@ -43,7 +47,9 @@ from ..prog.mutation import minimize, mutate
 from ..prog.prio import build_choice_table
 from ..prog.prog import Prog
 from ..utils.hash import hash_str
+from . import checkpoint as _ckpt
 from .queue import CandidateItem, SmashItem, TriageItem, WorkQueue
+from .supervisor import EnvSupervisor
 
 # exec-stat -> attribution phase (the stat strings are the RPC wire
 # vocabulary; the ledger speaks the ISSUE 2 phase vocabulary)
@@ -84,6 +90,16 @@ class FuzzerConfig:
     detect_supported: bool = False      # probe the live machine (pkg/host)
     leak_check: bool = False            # kmemleak scan every leak_period
     leak_period: int = 1000             # executions between scans
+    # ---- campaign supervision ----
+    workdir: str = ""                   # engine.ckpt lives here ("" = off)
+    resume: bool = False                # restore workdir/engine.ckpt at init
+    checkpoint_interval: float = 60.0   # seconds between checkpoints
+    env_quarantine_threshold: int = 3   # consecutive failures -> quarantine
+    env_base_backoff: float = 0.05      # first supervised-restart delay (s)
+    env_max_backoff: float = 5.0        # backoff ceiling (s)
+    env_probe_interval: float = 1.0     # quarantined-env probe cadence (s)
+    env_watchdog_seconds: float = 0.0   # per-exec watchdog deadline (0=off)
+    drain_max_attempts: int = 3         # per-row attempts across envs
 
 
 class ManagerConn:
@@ -164,6 +180,29 @@ class Fuzzer:
             "device_drain_env_occupancy",
             help="fraction of executor envs that ran rows in the last "
                  "device-batch drain")
+        # campaign supervision: checkpoint + RPC + drain-retry accounting
+        # (rpc_errors_total itself is owned by manager/rpc.RemoteManager —
+        # one counter per transport attempt; engine-level sync failures
+        # land in errors_rpc_poll_total via count_error, not here, so one
+        # logical failure is never counted twice)
+        self._pending_new_inputs: List[tuple] = []
+        self._h_ckpt_write = reg.histogram(
+            "checkpoint_write_seconds",
+            help="wall time of one atomic engine checkpoint write")
+        self._m_ckpt_writes = reg.counter(
+            "checkpoint_writes_total", help="engine checkpoints written")
+        self._m_ckpt_restores = reg.counter(
+            "checkpoint_restores_total",
+            help="engine checkpoints restored on resume")
+        self._m_ckpt_rejected = reg.counter(
+            "checkpoint_rejected_total",
+            help="checkpoints rejected at resume (corrupt, truncated, or "
+                 "incompatible) — the engine starts fresh instead")
+        self._m_rows_dropped = reg.counter(
+            "drain_rows_dropped_total",
+            help="device-batch rows dropped after exhausting drain "
+                 "retries across envs")
+        self._last_ckpt_time = 0.0
         # fuzzer_-prefixed: the manager owns the bare corpus_size gauge,
         # and in-process deployments share one registry.  Weakref-bound
         # and detached in close(): the registry outlives fuzzer
@@ -176,6 +215,12 @@ class Fuzzer:
             (reg.gauge("fuzzer_max_signal_size",
                        help="accumulated max-signal PCs"),
              lambda: len(s.max_signal) if (s := ref()) is not None else 0),
+            (reg.gauge("checkpoint_age_seconds",
+                       help="seconds since the last engine checkpoint "
+                            "was written (-1 before the first write)"),
+             lambda: ((time.time() - s._last_ckpt_time)
+                      if (s := ref()) is not None and s._last_ckpt_time
+                      else -1.0)),
         ]
         for g, fn in self._gauge_fns:
             g.set_fn(fn)
@@ -205,6 +250,15 @@ class Fuzzer:
             else:
                 ec = self.cfg.env_config or EnvConfig(sandbox=self.cfg.sandbox)
                 self.envs.append(Env(target, pid=pid, config=ec))
+        # drain-path supervision: backoff/quarantine/watchdog over the fleet
+        self.supervisor = EnvSupervisor(
+            len(self.envs),
+            quarantine_threshold=self.cfg.env_quarantine_threshold,
+            base_backoff=self.cfg.env_base_backoff,
+            max_backoff=self.cfg.env_max_backoff,
+            probe_interval=self.cfg.env_probe_interval,
+            watchdog_seconds=self.cfg.env_watchdog_seconds,
+            seed=seed)
 
         self._leak = None
         self.leak_reports = []
@@ -225,10 +279,24 @@ class Fuzzer:
                 # two or the (nbits-1) mask zeroes arbitrary positions
                 nbits = 1 << (self.cfg.mirror_bits - 1).bit_length()
                 self._max_bits = _np.zeros(nbits // 32, dtype=_np.uint32)
-            except Exception:
+            except Exception as e:
+                count_error("device_init", e)
                 self._device = None  # no jax available: host-only mode
 
         self._iter = 0
+
+        # checkpoint/resume: workdir/engine.ckpt is this engine's
+        # corpus.db analogue — see engine/checkpoint.py
+        self.checkpoint_path = (
+            os.path.join(self.cfg.workdir, "engine.ckpt")
+            if self.cfg.workdir else "")
+        if self.cfg.workdir:
+            os.makedirs(self.cfg.workdir, exist_ok=True)
+        self._next_ckpt = time.monotonic() + max(
+            self.cfg.checkpoint_interval, 0.0)
+        if self.cfg.resume and self.checkpoint_path and \
+                os.path.exists(self.checkpoint_path):
+            self.restore()
 
     # ---- lifecycle ----
 
@@ -238,6 +306,7 @@ class Fuzzer:
             self._drain_pool = None
         for e in self.envs:
             e.close()
+        self.supervisor.close()
         for g, fn in getattr(self, "_gauge_fns", ()):
             g.clear_fn(fn)
         if self._device is not None:
@@ -256,7 +325,10 @@ class Fuzzer:
 
         try:
             p = deserialize(self.target, text)
-        except Exception:
+        except Exception as e:
+            # a corrupt corpus entry from the manager sync must not kill
+            # the loop, but it must not vanish either
+            count_error("corpus_deserialize", e)
             return
         if self._add_corpus(p, ()):
             # connect-time corpus import: credited to the seed phase (no
@@ -269,7 +341,8 @@ class Fuzzer:
 
         try:
             p = deserialize(self.target, text)
-        except Exception:
+        except Exception as e:
+            count_error("candidate_deserialize", e)
             return
         self.queue.push_candidate(CandidateItem(p))
 
@@ -432,9 +505,28 @@ class Fuzzer:
         self.stats["new_inputs"] += 1
         self._m_new_inputs.inc()
         self._ledger.record_corpus_add(origin.phase, origin.ops)
-        self.manager.new_input(serialize(item.prog), item.call_index,
+        self._report_new_input(serialize(item.prog), item.call_index,
                                sig_list, sorted(cover))
         self.queue.push_smash(SmashItem(item.prog, item.call_index))
+
+    def _report_new_input(self, text: str, call_index: int,
+                          signal: List[int], cover: List[int]) -> None:
+        """Report a corpus addition to the manager; a manager outage must
+        not kill the campaign (the input is already in the local corpus),
+        so failures are logged + counted and the report is RETAINED —
+        poll_manager re-sends the backlog once the manager is back."""
+        try:
+            self.manager.new_input(text, call_index, signal, cover)
+        except Exception as e:
+            count_error("rpc_new_input", e)
+            self._pending_new_inputs.append(
+                (text, call_index, signal, cover))
+            dropped = len(self._pending_new_inputs) - 1024
+            if dropped > 0:  # bound the backlog — but never silently
+                count_error("rpc_new_input_dropped", RuntimeError(
+                    f"{dropped} oldest new_input report(s) dropped, "
+                    f"backlog full"))
+                del self._pending_new_inputs[:dropped]
 
     @staticmethod
     def _call_signal(infos: List[CallInfo], call_index: int
@@ -562,30 +654,87 @@ class Fuzzer:
 
     def _run_device_batch_inner(self, batch) -> None:
         """Drain one device batch across ALL executor envs: one worker per
-        env pulls rows off a shared cursor (dynamic balancing — a row that
-        skips costs ~nothing, a row that executes costs an exec round
-        trip), so per-env serialization is preserved by construction while
-        the fleet drains in parallel.  Stat/ledger updates go through the
-        locked ``_record_exec`` helper; triage enqueue and corpus adds are
-        already thread-safe; the signal mirror is folded ONCE per batch,
-        on the calling thread, after the workers join."""
+        env pulls rows off a shared pending deque (dynamic balancing — a
+        row that skips costs ~nothing, a row that executes costs an exec
+        round trip), so per-env serialization is preserved by construction
+        while the fleet drains in parallel.
+
+        The fan-out is SUPERVISED (engine/supervisor.py): an exec failure
+        records against the env (jittered-backoff restart, quarantine
+        past the threshold) and the row goes back on the deque so a
+        surviving env re-executes it — rows are executed exactly once on
+        success, and only dropped (counted) after ``drain_max_attempts``
+        distinct attempts.  A worker whose env is quarantined leaves the
+        remaining rows to the survivors when any exist; otherwise it
+        waits out the backoff and relies on un-quarantine probes, so a
+        fully-failed fleet still makes progress once envs recover.
+
+        Stat/ledger updates go through the locked ``_record_exec``
+        helper; triage enqueue and corpus adds are already thread-safe;
+        the signal mirror is folded ONCE per batch, on the calling
+        thread, after the workers join."""
         n = len(batch)
         nworkers = max(min(len(self.envs), n), 1)
-        rows = iter(range(n))
+        pending = deque((row, 0) for row in range(n))
         rows_lock = threading.Lock()
+        active = [nworkers]  # workers still in their loop (rows_lock)
+        sup = self.supervisor
+        max_attempts = max(self.cfg.drain_max_attempts, 1)
 
         def drain(env_idx: int):
             sigs: List[List[int]] = []
             done = 0
-            while True:
-                with rows_lock:
-                    row = next(rows, None)
-                if row is None:
-                    return sigs, done
-                sig = self._drain_row(batch, row, env_idx)
-                done += 1
-                if sig is not None:
-                    sigs.append(sig)
+            left = False
+            try:
+                while True:
+                    item = None
+                    with rows_lock:
+                        if not pending:
+                            active[0] -= 1
+                            left = True
+                            return sigs, done
+                        if sup.acquire(env_idx):
+                            item = pending.popleft()
+                        elif active[0] > 1 and \
+                                sup.usable_elsewhere(env_idx):
+                            # hand remaining rows to the survivors; the
+                            # check and the worker-count decrement are
+                            # atomic so the LAST worker can never leave
+                            # (it waits out backoff and relies on
+                            # un-quarantine probes — otherwise two dying
+                            # workers could each trust the other and
+                            # strand the rows)
+                            active[0] -= 1
+                            left = True
+                            return sigs, done
+                    if item is None:
+                        time.sleep(0.005)
+                        continue
+                    row, attempts = item
+                    status, sig = self._drain_row(batch, row, env_idx)
+                    if status == "env_failure":
+                        # charge the env only for a row's FIRST failure:
+                        # a row that already failed elsewhere is evidence
+                        # the program (the kind of input a fuzzer exists
+                        # to find) is the problem, and re-charging it
+                        # would quarantine healthy envs one by one
+                        if attempts == 0:
+                            sup.record_failure(env_idx)
+                        with rows_lock:
+                            if attempts + 1 < max_attempts:
+                                pending.append((row, attempts + 1))
+                            else:
+                                self._m_rows_dropped.inc()
+                        continue
+                    if status == "ok":
+                        sup.record_success(env_idx)
+                    done += 1  # ok/skip/fail/hang all consume the row
+                    if sig:
+                        sigs.append(sig)
+            finally:
+                if not left:  # exception path: stop counting as active
+                    with rows_lock:
+                        active[0] -= 1
 
         results = []
         first_exc = None
@@ -610,27 +759,57 @@ class Fuzzer:
         if first_exc is not None:
             raise first_exc
 
-    def _drain_row(self, batch, row: int,
-                   env_idx: int) -> Optional[List[int]]:
-        """Execute one batch row on env ``env_idx``; returns the row's
-        executed signal (fed to the per-batch mirror fold) or None when
-        the row was skipped/failed.  Runs on drain worker threads — only
-        thread-safe state may be touched (see _run_device_batch_inner)."""
+    def _drain_row(self, batch, row: int, env_idx: int):
+        """Execute one batch row on env ``env_idx``; returns
+        ``(status, signal)`` where status is one of
+
+          ``ok``          — executed cleanly (signal feeds the mirror fold)
+          ``skip``        — nothing to run (empty mutation / no decode /
+                            oversized stream the env would deterministically
+                            reject)
+          ``fail``        — consumed without env attribution either way:
+                            STATUS_FAILED from a LIVE executor (call
+                            records present — a program property), or a
+                            decode-fallback row whose execute() hides
+                            the env outcome
+          ``hang``        — the program hung; the env enforced its timeout
+                            correctly, so this is not an env failure
+          ``env_failure`` — the executor died (crash, injected kill,
+                            watchdog interrupt — failed with NO call
+                            records): the caller re-shards the row onto a
+                            surviving env
+
+        Runs on drain worker threads — only thread-safe state may be
+        touched (see _run_device_batch_inner)."""
         origin = Provenance(_attr.PHASE_MUTATE,
                             ops_from_mask(batch.op_mask(row)))
         stream = batch.streams[row]
         if stream is None:
             p = batch.decode(row)
             if p is None:
-                return None
+                return "skip", None
             # fallback rows take the regular execute() path on this
-            # worker's env (pid pins the env, keeping serialization)
-            infos = self.execute(p, "exec_fuzz", pid=env_idx,
-                                 origin=origin)
-            return sorted({s for info in infos or () for s in info.signal})
+            # worker's env (pid pins the env, keeping serialization);
+            # execute() consumes failures internally, so these rows are
+            # not re-sharded — they are the rare codec long tail.  The
+            # watchdog still guards the call, but the status is "fail"
+            # (consumed, NO success credit): execute() hides whether the
+            # env died, and crediting success here would let a sick env
+            # reset its failure streak on every fallback row
+            with self.supervisor.guard(env_idx, self.envs[env_idx]):
+                infos = self.execute(p, "exec_fuzz", pid=env_idx,
+                                     origin=origin)
+            return "fail", sorted(
+                {s for info in infos or () for s in info.signal})
         call_ids = batch.call_ids(row)
         if len(call_ids) <= 1:
-            return None  # mutation emptied the program: nothing to run
+            return "skip", None  # mutation emptied the program
+        from ..ipc import protocol as _P
+
+        if len(stream) > _P.IN_SHM_SIZE:
+            # the env rejects this deterministically while staying
+            # healthy — charging/re-sharding it would indict good envs
+            return "skip", None
         if self.cfg.log_programs:
             # crash attribution/repro parses these records from the
             # console log — raw streams must log like execute() does
@@ -638,11 +817,22 @@ class Fuzzer:
             if p is not None:
                 from ..utils.log import logf
                 logf(0, "executing program %d:\n%s", env_idx, serialize(p))
-        _, infos, failed, hanged = self.envs[env_idx].exec_raw(
-            ExecOpts(), stream, call_ids)
+        env = self.envs[env_idx]
+        try:
+            with self.supervisor.guard(env_idx, env):
+                _, infos, failed, hanged = env.exec_raw(
+                    ExecOpts(), stream, call_ids)
+        except Exception as e:
+            count_error("drain_exec", e)
+            return "env_failure", None
         self._record_exec("exec_fuzz", origin)
-        if failed or hanged:
-            return None
+        if failed:
+            # call records present => the executor is alive and replied
+            # STATUS_FAILED (a program property); absent => it died
+            # mid-request and the row deserves a surviving env
+            return ("fail" if infos else "env_failure"), None
+        if hanged:
+            return "hang", None
         decoded = None
         for info in infos:
             diff = self._signal_diff(info.signal)
@@ -654,7 +844,7 @@ class Fuzzer:
                 self.queue.push_triage(TriageItem(
                     prog=decoded.clone(), call_index=info.index,
                     signal=diff, origin=origin))
-        return sorted({s for info in infos for s in info.signal})
+        return "ok", sorted({s for info in infos for s in info.signal})
 
     # ---- the loop ----
 
@@ -665,7 +855,10 @@ class Fuzzer:
         # The TPU candidate factory runs on a fixed cadence regardless of
         # queue pressure — it is the primary fuzz source, double-buffered so
         # a batch is always cooking while the fleet executes the last one.
-        if (self._device is not None and self.corpus
+        # A pipeline that degraded off the device (XLA step ladder
+        # exhausted) is skipped — the host mutation path below takes over.
+        if (self._device is not None and not self._device.degraded
+                and self.corpus
                 and self._iter % self.cfg.device_period == 0):
             batch = self._device.candidates(self.corpus)
             if batch is not None:
@@ -713,6 +906,7 @@ class Fuzzer:
                 break
             self.step()
             i += 1
+            self.maybe_checkpoint()
             if self._leak is not None and \
                     self.stats["exec_total"] >= self._next_leak_scan:
                 self._next_leak_scan = self.stats["exec_total"] + \
@@ -725,16 +919,244 @@ class Fuzzer:
                         len(leaks)
 
     def poll_manager(self) -> None:
-        """Exchange stats/new-signal with the manager (fuzzer.go:334-427)."""
-        stats = dict(self.stats)
-        r = self.manager.poll(stats, need_candidates=not self.corpus,
-                              new_signal=sorted(self.new_signal))
+        """Exchange stats/new-signal with the manager (fuzzer.go:334-427).
+
+        A failed sync is logged + counted (``errors_rpc_poll_total``; the
+        transport-attempt counter ``rpc_errors_total`` is RemoteManager's
+        and is not double-bumped here) and the un-synced ``new_signal``
+        is RETAINED for the next poll — a manager restart costs one
+        missed exchange, not the campaign.  Transport-level
+        retry/backoff and restart-aware reconnect live in
+        manager/rpc.RemoteManager; this is the last-resort engine-side
+        net under it."""
+        with self._stats_lock:
+            stats = dict(self.stats)
+        try:
+            _faults.fire("rpc.poll")
+            r = self.manager.poll(stats, need_candidates=not self.corpus,
+                                  new_signal=sorted(self.new_signal))
+        except Exception as e:
+            count_error("rpc_poll", e)
+            return
         for text in r.get("new_inputs", ()):
             self._add_corpus_text(text)
         for text in r.get("candidates", ()):
             self._push_candidate_text(text)
         self.max_signal.update(r.get("max_signal", ()))
         self.new_signal.clear()
+        # the manager is reachable again: drain the retained new_input
+        # backlog (reports that failed while it was down)
+        while self._pending_new_inputs:
+            args = self._pending_new_inputs[0]
+            try:
+                self.manager.new_input(*args)
+            except Exception as e:
+                count_error("rpc_new_input", e)
+                break  # still flaky: keep the rest for the next poll
+            self._pending_new_inputs.pop(0)
+
+    # ---- checkpoint / resume (engine/checkpoint.py) ----
+
+    def checkpoint_state(self) -> dict:
+        """Everything a ``--resume`` run needs to continue bit-identically:
+        host signal sets + the max-signal bitset mirror, the corpus, the
+        seeded RNG stream, queued work, the attribution ledger, wire
+        stats, and — when the device pipeline is live — the resident
+        arena (rows + ring cursor), the sharded proxy bitset, and the
+        device PRNG key.  Called from the scheduling thread only (no
+        drain is in flight between steps)."""
+        with self._lock:
+            corpus = [serialize(p) for p in self.corpus]
+            corpus_signal = sorted(self.corpus_signal)
+        with self._stats_lock:
+            stats = dict(self.stats)
+        state = {
+            "stats": stats,
+            "corpus": corpus,
+            "corpus_signal": corpus_signal,
+            "max_signal": sorted(self.max_signal),
+            "new_signal": sorted(self.new_signal),
+            "seed_rng": self.rng.rng.getstate(),
+            "iter": self._iter,
+            "queue": self._queue_state(),
+            "ledger": self._ledger.state(),
+            "max_bits": (self._max_bits.copy()
+                         if self._max_bits is not None else None),
+        }
+        if self._device is not None and not self._device.degraded:
+            # a degraded pipeline's device state is unreadable/stale by
+            # definition — resume rebuilds the arena from the corpus
+            state["device"] = self._device.checkpoint_state()
+        return state
+
+    def _queue_state(self) -> dict:
+        items = self.queue.snapshot_items()
+
+        def enc_triage(t: TriageItem) -> dict:
+            return {"prog": serialize(t.prog), "call_index": t.call_index,
+                    "signal": list(t.signal),
+                    "from_candidate": t.from_candidate,
+                    "minimized": t.minimized,
+                    "origin": ((t.origin.phase, list(t.origin.ops))
+                               if t.origin is not None else None)}
+
+        return {
+            "triage": [enc_triage(t)
+                       for t in items["triage_candidate"] + items["triage"]],
+            "candidate": [{"prog": serialize(c.prog),
+                           "minimized": c.minimized}
+                          for c in items["candidate"]],
+            "smash": [{"prog": serialize(s.prog),
+                       "call_index": s.call_index}
+                      for s in items["smash"]],
+        }
+
+    def save_checkpoint(self, path: str = "") -> int:
+        """Atomically write the engine checkpoint; returns payload bytes."""
+        path = path or self.checkpoint_path
+        if not path:
+            raise ValueError(
+                "no checkpoint path (set FuzzerConfig.workdir or pass one)")
+        t0 = time.perf_counter()
+        n = _ckpt.write_checkpoint(path, self.checkpoint_state())
+        self._h_ckpt_write.observe(time.perf_counter() - t0)
+        self._m_ckpt_writes.inc()
+        self._last_ckpt_time = time.time()
+        self._next_ckpt = time.monotonic() + max(
+            self.cfg.checkpoint_interval, 0.0)
+        return n
+
+    def maybe_checkpoint(self, force: bool = False) -> bool:
+        """Periodic checkpoint gate, called from loop() between steps."""
+        if not self.checkpoint_path:
+            return False
+        if not force and (self.cfg.checkpoint_interval <= 0
+                          or time.monotonic() < self._next_ckpt):
+            return False
+        try:
+            self.save_checkpoint()
+        except Exception as e:
+            # a full/readonly disk — or a sick accelerator raising from
+            # device_get while gathering device state — must not kill
+            # the campaign the supervision layer exists to keep alive
+            count_error("checkpoint_write", e)
+            self._next_ckpt = time.monotonic() + max(
+                self.cfg.checkpoint_interval, 1.0)
+            return False
+        return True
+
+    def restore(self, path: str = "") -> bool:
+        """Load ``path`` (default: the configured checkpoint) into this
+        fuzzer.  Any defect — corruption, truncation, incompatible shapes
+        — is rejected with a logged + counted error and ``False``: the
+        engine starts fresh instead of crashing or loading garbage."""
+        path = path or self.checkpoint_path
+        try:
+            st = _ckpt.read_checkpoint(path)
+        except _ckpt.CheckpointError as e:
+            self._m_ckpt_rejected.inc()
+            count_error("checkpoint_load", e)
+            return False
+        try:
+            self._apply_checkpoint(st)
+        except Exception as e:
+            self._m_ckpt_rejected.inc()
+            count_error("checkpoint_apply", e)
+            return False
+        self._m_ckpt_restores.inc()
+        self._last_ckpt_time = time.time()
+        return True
+
+    def _apply_checkpoint(self, st: dict) -> None:
+        """Two-phase restore: parse/validate EVERYTHING first (raising
+        before any engine state mutates), then install.  A checkpoint
+        from a different corpus format or device config fails in phase
+        one and leaves the fresh engine untouched."""
+        from ..prog.encoding import deserialize
+
+        # -- phase 1: decode and validate --
+        corpus: List[Prog] = []
+        hashes: Set[str] = set()
+        for text in st["corpus"]:
+            p = deserialize(self.target, text)
+            corpus.append(p)
+            hashes.add(hash_str(serialize(p).encode()))
+        qs = st.get("queue", {})
+        triage_items = []
+        for d in qs.get("triage", ()):
+            origin = d.get("origin")
+            triage_items.append(TriageItem(
+                prog=deserialize(self.target, d["prog"]),
+                call_index=int(d["call_index"]),
+                signal=list(d["signal"]),
+                from_candidate=bool(d.get("from_candidate")),
+                minimized=bool(d.get("minimized")),
+                origin=(Provenance(origin[0], origin[1])
+                        if origin else None)))
+        cand_items = [CandidateItem(deserialize(self.target, d["prog"]),
+                                    minimized=bool(d.get("minimized")))
+                      for d in qs.get("candidate", ())]
+        smash_items = [SmashItem(deserialize(self.target, d["prog"]),
+                                 call_index=int(d["call_index"]))
+                       for d in qs.get("smash", ())]
+        max_bits = st.get("max_bits")
+        if max_bits is not None and self._max_bits is not None:
+            import numpy as np
+
+            max_bits = np.asarray(max_bits, dtype=np.uint32).copy()
+            if max_bits.shape != self._max_bits.shape:
+                # a mirror from a different mirror_bits config would
+                # fold hashes at the wrong modulus — reject, don't drift
+                raise ValueError(
+                    f"checkpoint max_bits shape {max_bits.shape} != "
+                    f"configured {self._max_bits.shape}")
+        corpus_signal = set(st["corpus_signal"])
+        max_signal = set(st["max_signal"])
+        new_signal = set(st["new_signal"])
+        if not isinstance(st["stats"], dict):
+            raise ValueError("checkpoint stats is not a dict")
+        # probe the RNG state on a scratch instance: a schema-bad state
+        # (e.g. a future writer that kept CKPT_VERSION) must fail here,
+        # not after half the engine state is installed
+        import random as _random
+
+        _random.Random().setstate(st["seed_rng"])
+        for rows in st.get("ledger", {}).values():
+            for cell in (rows or {}).values():
+                e, ns, ca = (int(x) for x in cell)  # arity + type check
+        dev_state = st.get("device")
+
+        # -- phase 2: install (device first: restore_state validates
+        # shapes before mutating and is the only remaining fallible
+        # step, so a failure still leaves the fresh engine untouched) --
+        if self._device is not None:
+            if dev_state is not None:
+                self._device.restore_state(dev_state)
+            else:
+                # checkpoint from a host-only (or degraded) run: rebuild
+                # the arena by re-encoding the restored corpus
+                for p in corpus:
+                    self._device.add_corpus(p)
+        with self._lock:
+            self.corpus = corpus
+            self.corpus_hashes = hashes
+            self.corpus_signal = corpus_signal
+        self.max_signal = max_signal
+        self.new_signal = new_signal
+        with self._stats_lock:
+            self.stats.update(st["stats"])
+        self.rng.rng.setstate(st["seed_rng"])
+        self._iter = int(st.get("iter", 0))
+        self._ledger.load_state(st.get("ledger", {}))
+        if max_bits is not None and self._max_bits is not None:
+            self._max_bits = max_bits
+        self.queue = WorkQueue()
+        for t in triage_items:
+            self.queue.push_triage(t)
+        for c in cand_items:
+            self.queue.push_candidate(c)
+        for s in smash_items:
+            self.queue.push_smash(s)
 
 
 class _DevicePipeline:
@@ -788,6 +1210,8 @@ class _DevicePipeline:
         self._key = jax.random.PRNGKey(1)
         self._pick = np.random.default_rng(1)
         self._pending = None  # in-flight device computation (double buffer)
+        self._sig_words = nwords
+        self.degraded = False  # ladder exhausted: host mutation path only
         self.target = target
         # device-resident encoded corpus: programs are encoded once on
         # add_corpus and stay on the chips; the launch path samples rows
@@ -805,6 +1229,18 @@ class _DevicePipeline:
             "device_batch_occupancy",
             help="fraction of the last device batch kept after the "
                  "on-device stale-candidate gate")
+        # degradation ladder accounting (retry -> recompile -> host)
+        self._c_step_retries = reg.counter(
+            "device_step_retries_total",
+            help="failed device fuzz steps retried in place")
+        self._c_step_recompiles = reg.counter(
+            "device_step_recompiles_total",
+            help="device fuzz steps rebuilt (fresh jit) after a retry "
+                 "also failed")
+        self._c_degraded = reg.counter(
+            "device_degraded_total",
+            help="device pipelines that exhausted the degradation ladder "
+                 "and fell back to the host mutation path")
 
         def _live_bytes():
             return sum(getattr(a, "nbytes", 0) for a in jax.live_arrays())
@@ -821,27 +1257,81 @@ class _DevicePipeline:
         batch = self._ProgBatch.empty(self.fmt, 1)
         try:
             self._encode_prog(self.tables, self.fmt, p, batch, 0)
-        except Exception:
-            return  # long-tail arg the tensor format can't carry yet
+        except Exception as e:
+            # long-tail arg the tensor format can't carry yet — count it
+            # so a codec regression shows as a rate, not silence
+            count_error("device_encode", e)
+            return
         self.arena.append(batch.call_id[0], batch.slot_val[0],
                           batch.data[0])
 
     def _launch(self):
-        jax = self._jax
+        """One device launch behind the degradation ladder: on an XLA/JIT
+        step failure retry once in place, then rebuild the jitted step
+        (recompile), then permanently fall back to the host mutation
+        path (``degraded`` — mirroring bench.py's cpu-fallback), counting
+        ``device_degraded_total``.  The campaign survives a sick
+        accelerator at reduced throughput instead of dying with it."""
+        if self.degraded:
+            return None
         idx = self.arena.sample_indices(self._pick, self.B)
         if idx is None:
             return None
+        from ..parallel import mesh as pmesh
+
+        for rung in ("try", "retry", "recompile"):
+            try:
+                if rung == "recompile":
+                    self._c_step_recompiles.inc()
+                    self._step, self._shardings = \
+                        pmesh.make_arena_fuzz_step(self.mesh, self.dt)
+                return self._launch_once(idx)
+            except Exception as e:
+                count_error("device_step", e)
+                self._heal_signal_shard()
+                if rung == "try":
+                    self._c_step_retries.inc()
+        self.degraded = True
+        self._c_degraded.inc()
+        from ..utils.log import logf
+
+        logf(0, "device pipeline degraded to host mutation path "
+                "(step failed after retry + recompile)")
+        return None
+
+    def _launch_once(self, idx):
+        jax = self._jax
         # the selection indices ([B] int32) are the ONLY per-launch H2D
         # transfer: the batch is gathered out of the resident arena with
         # jnp.take inside the jitted sharded step, and the signal bitset
         # updates in place (donated)
         with span("device.batch_stage"):
+            _faults.fire("device.step")
             self._key, kmut = jax.random.split(self._key)
-            idx = jax.device_put(idx, self._shardings["batch"])
+            idx_dev = jax.device_put(idx, self._shardings["batch"])
             a_cid, a_sval, a_data = self.arena.tensors()
             cid, sval, data, self._sig_shard, fresh, op_mask = self._step(
-                kmut, idx, a_cid, a_sval, a_data, self._sig_shard)
+                kmut, idx_dev, a_cid, a_sval, a_data, self._sig_shard)
         return cid, sval, data, fresh, op_mask
+
+    def _heal_signal_shard(self) -> None:
+        """A failed step may have consumed the donated proxy bitset;
+        rebuild it empty before the next rung.  Conservative: lost proxy
+        state only means some stale candidates re-test as fresh — extra
+        host work, never lost coverage (the exact sets live on the
+        host)."""
+        jax = self._jax
+        import jax.numpy as jnp
+
+        buf = self._sig_shard
+        try:
+            deleted = bool(buf.is_deleted())
+        except Exception:
+            deleted = False  # no introspection: assume still live
+        if deleted:
+            self._sig_shard = jax.device_put(
+                jnp.zeros(self._sig_words, jnp.uint32),
+                self._shardings["signal"])
 
     def candidates(self, corpus: List[Prog]) -> Optional["_DeviceBatch"]:
         """Return the previously launched batch — raw exec streams with a
@@ -870,6 +1360,70 @@ class _DevicePipeline:
         streams = self._execgen.emit_batch(batch)
         return _DeviceBatch(self, batch, streams, dropped=dropped,
                             op_masks=op_mask)
+
+    # ---- checkpoint round-trip (engine/checkpoint.py) ----
+
+    def checkpoint_state(self) -> dict:
+        """Device-resident state a resume must restore bit-identically:
+        the corpus arena (rows + ring cursor/size/evictions), the sharded
+        proxy signal bitset, and both candidate-pipeline RNGs."""
+        import numpy as np
+
+        jax = self._jax
+        a_cid, a_sval, a_data = self.arena.tensors()
+        return {
+            "arena": {
+                "cid": np.asarray(jax.device_get(a_cid)),
+                "sval": np.asarray(jax.device_get(a_sval)),
+                "data": np.asarray(jax.device_get(a_data)),
+                "size": self.arena.size,
+                "cursor": self.arena.cursor,
+                "evictions": self.arena.evictions,
+            },
+            "sig_shard": np.asarray(jax.device_get(self._sig_shard)),
+            "key": np.asarray(jax.device_get(self._key)),
+            "pick": self._pick.bit_generator.state,
+        }
+
+    def validate_state(self, st: dict) -> None:
+        """Raise before any restore mutation if the checkpoint's device
+        shapes don't match this pipeline's config (different
+        arena_capacity / mirror_bits / program_length)."""
+        import numpy as np
+
+        ar = st["arena"]
+        a_cid, a_sval, a_data = self.arena.tensors()
+        for name, got, want in (("cid", ar["cid"], a_cid),
+                                ("sval", ar["sval"], a_sval),
+                                ("data", ar["data"], a_data)):
+            if tuple(np.shape(got)) != tuple(want.shape):
+                raise ValueError(
+                    f"checkpoint arena {name} shape {np.shape(got)} != "
+                    f"configured {tuple(want.shape)}")
+        if tuple(np.shape(st["sig_shard"])) != \
+                tuple(self._sig_shard.shape):
+            raise ValueError(
+                f"checkpoint sig_shard shape {np.shape(st['sig_shard'])} "
+                f"!= configured {tuple(self._sig_shard.shape)}")
+
+    def restore_state(self, st: dict) -> None:
+        import numpy as np
+        import jax.numpy as jnp
+
+        jax = self._jax
+        self.validate_state(st)
+        ar = st["arena"]
+        self.arena.restore(ar["cid"], ar["sval"], ar["data"],
+                           size=int(ar["size"]), cursor=int(ar["cursor"]),
+                           evictions=int(ar.get("evictions", 0)))
+        self._sig_shard = jax.device_put(
+            jnp.asarray(np.asarray(st["sig_shard"], np.uint32)),
+            self._shardings["signal"])
+        self._key = jnp.asarray(st["key"])
+        pick = np.random.default_rng()
+        pick.bit_generator.state = st["pick"]
+        self._pick = pick
+        self._pending = None  # any in-flight pre-restore batch is stale
 
 
 class _DeviceBatch:
@@ -927,7 +1481,10 @@ class _DeviceBatch:
             # decode_prog runs assign_sizes_call + sanitize_call per call
             p = decode_prog(self.pipe.tables, self.pipe.fmt,
                             self.batch, row)
-        except Exception:
+        except Exception as e:
+            # codec long tail: the row still executed as a raw stream,
+            # only triage loses it — count so regressions are visible
+            count_error("device_decode", e)
             p = None
         self._decoded[row] = p
         return p

@@ -78,3 +78,16 @@ class WorkQueue:
                 "triage": len(self._triage),
                 "smash": len(self._smash),
             }
+
+    def snapshot_items(self):
+        """Consistent copy of all queued items in priority order, for the
+        engine checkpoint (engine/checkpoint.py).  The items themselves
+        are shared, not cloned — the caller serializes them immediately
+        while no worker is draining."""
+        with self._lock:
+            return {
+                "triage_candidate": list(self._triage_candidate),
+                "candidate": list(self._candidate),
+                "triage": list(self._triage),
+                "smash": list(self._smash),
+            }

@@ -1,0 +1,248 @@
+"""Executor-env supervisor: the drain path's restart/backoff/quarantine
+brain (the engine-side analogue of the reference manager's vmLoop, which
+reschedules crashed VM instances instead of dying with them).
+
+Per env the supervisor tracks consecutive failures and schedules
+supervised restarts with jittered exponential backoff (an env that just
+crashed is not immediately re-fed — thundering-herd restarts after a
+correlated fault would re-crash the fleet in lockstep).  After
+``quarantine_threshold`` consecutive failures the env is quarantined:
+the batch fan-out re-shards its rows across the surviving envs, and the
+quarantined env only sees periodic un-quarantine *probes* (one row per
+``probe_interval``) — a probe success restores it to full service.
+
+An optional per-call watchdog guards against the failure mode backoff
+cannot see: a *wedged* env that neither fails nor returns.  Workers arm
+a deadline around each exec; a single monitor thread scans the in-flight
+table and, past the deadline, calls ``env.interrupt()`` (ipc kills the
+executor process, unblocking the worker's pipe read into the ordinary
+failure path) and counts ``env_watchdog_trips_total``.
+
+All decisions are host-side and lock-cheap; the seeded jitter RNG makes
+backoff schedules reproducible under the fault-injection harness.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..telemetry import get_registry
+
+
+class _EnvState:
+    __slots__ = ("failures", "not_before", "quarantined", "last_probe",
+                 "last_backoff")
+
+    def __init__(self):
+        self.failures = 0
+        self.not_before = 0.0
+        self.quarantined = False
+        self.last_probe = 0.0
+        self.last_backoff = 0.0
+
+
+class EnvSupervisor:
+    """Supervision state machine over ``n_envs`` executor environments."""
+
+    def __init__(self, n_envs: int, *, quarantine_threshold: int = 3,
+                 base_backoff: float = 0.05, max_backoff: float = 5.0,
+                 probe_interval: float = 1.0,
+                 watchdog_seconds: float = 0.0, seed: int = 0,
+                 registry=None, time_fn=time.monotonic):
+        self.n_envs = max(int(n_envs), 1)
+        self.quarantine_threshold = max(int(quarantine_threshold), 1)
+        self.base_backoff = float(base_backoff)
+        self.max_backoff = float(max_backoff)
+        self.probe_interval = float(probe_interval)
+        self.watchdog_seconds = float(watchdog_seconds)
+        self._time = time_fn
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._envs = [_EnvState() for _ in range(self.n_envs)]
+
+        reg = registry or get_registry()
+        self._c_restarts = reg.counter(
+            "env_restarts_total",
+            help="supervised executor-env restarts scheduled after a "
+                 "failure (backoff applies before the env is re-fed)")
+        self._g_quarantined = reg.gauge(
+            "env_quarantined",
+            help="executor envs currently quarantined after repeated "
+                 "consecutive failures")
+        self._c_watchdog = reg.counter(
+            "env_watchdog_trips_total",
+            help="wedged executor calls interrupted by the per-call "
+                 "watchdog deadline")
+        self._c_probes = reg.counter(
+            "env_unquarantine_probes_total",
+            help="probe executions granted to quarantined envs")
+        self._g_quarantined.set(0)
+
+        # watchdog: in-flight exec deadlines, scanned by one monitor
+        # thread (started lazily on the first guarded call)
+        self._inflight: Dict[int, Tuple[float, object]] = {}
+        self._stop = threading.Event()
+        self._monitor: Optional[threading.Thread] = None
+
+    # ---- scheduling decisions (drain workers) ----
+
+    def acquire(self, env_idx: int) -> bool:
+        """May env ``env_idx`` take a row right now?  Quarantined envs
+        are granted one probe per ``probe_interval``; envs inside their
+        backoff window are refused."""
+        now = self._time()
+        with self._lock:
+            st = self._envs[env_idx]
+            if st.quarantined:
+                if now - st.last_probe >= self.probe_interval:
+                    st.last_probe = now
+                    self._c_probes.inc()
+                    return True
+                return False
+            return now >= st.not_before
+
+    def usable_elsewhere(self, env_idx: int) -> bool:
+        """True if any OTHER env is un-quarantined (this env's worker may
+        leave its remaining rows to the survivors)."""
+        with self._lock:
+            return any(i != env_idx and not st.quarantined
+                       for i, st in enumerate(self._envs))
+
+    # ---- outcomes ----
+
+    def record_failure(self, env_idx: int) -> None:
+        """One exec failed on ``env_idx``: schedule a supervised restart
+        with jittered exponential backoff; quarantine past the
+        threshold."""
+        with self._lock:
+            st = self._envs[env_idx]
+            st.failures += 1
+            self._c_restarts.inc()
+            backoff = min(self.max_backoff,
+                          self.base_backoff *
+                          (2 ** min(st.failures - 1, 20)))
+            backoff *= 0.5 + self._rng.random()  # jitter in [0.5, 1.5)
+            st.last_backoff = backoff
+            st.not_before = self._time() + backoff
+            if not st.quarantined and \
+                    st.failures >= self.quarantine_threshold:
+                st.quarantined = True
+                self._update_quarantine_gauge_locked()
+
+    def record_success(self, env_idx: int) -> None:
+        """A clean exec on ``env_idx``: reset failures and, if this was
+        an un-quarantine probe, restore the env to full service."""
+        with self._lock:
+            st = self._envs[env_idx]
+            st.failures = 0
+            st.not_before = 0.0
+            if st.quarantined:
+                st.quarantined = False
+                self._update_quarantine_gauge_locked()
+
+    def _update_quarantine_gauge_locked(self) -> None:
+        self._g_quarantined.set(
+            sum(1 for st in self._envs if st.quarantined))
+
+    # ---- introspection (tests, dashboard) ----
+
+    def is_quarantined(self, env_idx: int) -> bool:
+        with self._lock:
+            return self._envs[env_idx].quarantined
+
+    def failures(self, env_idx: int) -> int:
+        with self._lock:
+            return self._envs[env_idx].failures
+
+    def last_backoff(self, env_idx: int) -> float:
+        with self._lock:
+            return self._envs[env_idx].last_backoff
+
+    def quarantined_count(self) -> int:
+        with self._lock:
+            return sum(1 for st in self._envs if st.quarantined)
+
+    # ---- per-call watchdog ----
+
+    def guard(self, env_idx: int, env):
+        """Context manager arming the watchdog deadline around one exec;
+        a no-op object when the watchdog is disabled (hot path stays
+        allocation-light)."""
+        if self.watchdog_seconds <= 0:
+            return _NULL_GUARD
+        return _Guard(self, env_idx, env)
+
+    def _arm(self, env_idx: int, env) -> None:
+        deadline = self._time() + self.watchdog_seconds
+        with self._lock:
+            self._inflight[env_idx] = (deadline, env)
+            if self._monitor is None:
+                self._monitor = threading.Thread(
+                    target=self._monitor_loop, daemon=True,
+                    name="syztpu-watchdog")
+                self._monitor.start()
+
+    def _disarm(self, env_idx: int) -> None:
+        with self._lock:
+            self._inflight.pop(env_idx, None)
+
+    def _monitor_loop(self) -> None:
+        poll = max(self.watchdog_seconds / 4.0, 0.005)
+        while not self._stop.wait(poll):
+            now = self._time()
+            with self._lock:
+                # interrupt UNDER the lock: a worker whose expired call
+                # just returned blocks in _arm until the kill lands, so
+                # the interrupt can only hit the expired exec (or an
+                # idle env, which respawns silently) — never a healthy
+                # next call that armed in between
+                for k, (deadline, env) in list(self._inflight.items()):
+                    if now <= deadline:
+                        continue
+                    del self._inflight[k]  # one trip per call
+                    self._c_watchdog.inc()
+                    interrupt = getattr(env, "interrupt", None)
+                    if interrupt is not None:
+                        try:
+                            interrupt()
+                        except Exception:
+                            pass  # env already died: worker unblocks anyway
+
+    def close(self) -> None:
+        self._stop.set()
+        m = self._monitor
+        if m is not None:
+            m.join(timeout=2.0)
+            self._monitor = None
+
+
+class _Guard:
+    __slots__ = ("_sup", "_env_idx", "_env")
+
+    def __init__(self, sup: EnvSupervisor, env_idx: int, env):
+        self._sup = sup
+        self._env_idx = env_idx
+        self._env = env
+
+    def __enter__(self):
+        self._sup._arm(self._env_idx, self._env)
+        return self
+
+    def __exit__(self, *exc):
+        self._sup._disarm(self._env_idx)
+
+
+class _NullGuard:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        pass
+
+
+_NULL_GUARD = _NullGuard()

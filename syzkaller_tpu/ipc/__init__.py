@@ -27,6 +27,7 @@ from typing import List, Optional, Tuple
 from ..prog.encodingexec import serialize_for_exec
 from ..prog.prog import Prog
 from ..telemetry import get_registry
+from ..testing import faults as _faults
 from . import protocol as P
 from .build import build_executor
 
@@ -35,6 +36,19 @@ def _exec_histogram():
     return get_registry().histogram(
         "ipc_exec_latency_seconds",
         help="wall time of one executor round trip (exec_raw)")
+
+
+def _env_respawns_counter():
+    return get_registry().counter(
+        "env_respawns_total",
+        help="executor processes respawned after an unexpected death")
+
+
+def _kill_escalations_counter():
+    return get_registry().counter(
+        "env_kill_escalations_total",
+        help="executor shutdowns escalated to SIGKILL after the "
+             "graceful quit timed out")
 
 _REQ = struct.Struct("<6Q")
 _REPLY = struct.Struct("<3Q")
@@ -168,8 +182,21 @@ class Env:
         if self._proc is None or self._proc.poll() is not None:
             if self._proc is not None:
                 self.restarts += 1
+                _env_respawns_counter().inc()
                 self._drain_proc()
             self._spawn()
+
+    def interrupt(self) -> None:
+        """Watchdog escalation (engine/supervisor.py): kill the executor
+        mid-call so a wedged exec unblocks — the worker's pipe read fails
+        and exec_raw reports the ordinary crash path; the next exec
+        respawns a fresh process."""
+        p = self._proc
+        if p is not None and p.poll() is None:
+            try:
+                p.kill()
+            except OSError:
+                pass
 
     def _drain_proc(self) -> None:
         if self._proc is None:
@@ -194,7 +221,14 @@ class Env:
             try:
                 self._proc.wait(timeout=2)
             except subprocess.TimeoutExpired:
+                # wedged executor: escalate to SIGKILL and reap — without
+                # the re-wait a zombie leaks and pins the shm files open
                 self._proc.kill()
+                _kill_escalations_counter().inc()
+                try:
+                    self._proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    pass  # unkillable (D-state): _drain_proc waits anyway
         self._drain_proc()
         for m in (self._in_mm, self._out_mm, self._in_f, self._out_f):
             try:
@@ -247,6 +281,17 @@ class Env:
         if len(data) > P.IN_SHM_SIZE:
             # deterministic host-side rejection; the executor is healthy,
             # don't tear it down (distinct from the crash path below)
+            return b"", [], True, False
+        if _faults.should_fire(f"env.exec:{self.pid}"):
+            # injected executor death (testing/faults.FaultPlan):
+            # indistinguishable from a real crash — proc torn down,
+            # call reports failed, next exec respawns
+            if self._proc is not None and self._proc.poll() is None:
+                try:
+                    self._proc.kill()
+                except OSError:
+                    pass
+            self._drain_proc()
             return b"", [], True, False
         failed = hanged = False
         t0 = time.perf_counter()
@@ -379,6 +424,11 @@ class MockEnv:
         stream (the one authority for both exec() and the raw path).
         Pointer-valued consts (>= data_offset) fingerprint as pointers."""
         from ..prog.encodingexec import decode_exec
+
+        if _faults.should_fire(f"env.exec:{self.pid}"):
+            # injected env death: report failed like a crashed executor
+            self.restarts += 1
+            return b"", [], True, False
 
         t0 = time.perf_counter()
         data_off = getattr(self.target, "data_offset", 512 << 20)

@@ -389,6 +389,17 @@ class ManagerHttp:
             parts.append("<h2>device health</h2>"
                          + _table(["gauge", "value"], health))
 
+        sup = [[k, _fmt_num(snap[k])] for k in (
+            "env_restarts_total", "env_quarantined",
+            "env_watchdog_trips_total", "env_kill_escalations_total",
+            "rpc_errors_total", "rpc_retries_total",
+            "device_degraded_total", "drain_rows_dropped_total",
+            "checkpoint_age_seconds", "checkpoint_writes_total",
+            "errors_total") if k in snap]
+        if sup:
+            parts.append("<h2>supervision</h2>"
+                         + _table(["metric", "value"], sup))
+
         att = get_ledger().snapshot()
         cols = ["execs", "corpus_adds", "new_signal", "adds_per_kexec",
                 "signal_per_kexec"]

@@ -12,11 +12,17 @@ Frame: u32 LE length + utf-8 JSON. Request {"method", "args"}; response
 from __future__ import annotations
 
 import json
+import random
 import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Any, Dict, Optional
+
+from ..telemetry import count_error, get_registry
+from ..testing import faults as _faults
+from ..testing.faults import InjectedFault
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 256 << 20
@@ -24,6 +30,11 @@ MAX_FRAME = 256 << 20
 
 class RpcError(RuntimeError):
     pass
+
+
+class RpcConnError(RpcError):
+    """Transport-level failure (connection closed/reset) — retryable,
+    unlike an application error the server replied with."""
 
 
 def _send(sock: socket.socket, obj: Any) -> None:
@@ -120,7 +131,7 @@ class RpcClient:
             _send(self._sock, {"method": method, "args": args})
             resp = _recv(self._sock)
         if resp is None:
-            raise RpcError("connection closed")
+            raise RpcConnError("connection closed")
         if "error" in resp:
             raise RpcError(resp["error"])
         return resp.get("result")
@@ -134,24 +145,96 @@ class RpcClient:
 
 class RemoteManager:
     """engine.ManagerConn implementation over RpcClient — what a fuzzer
-    process uses to talk to a manager on another machine/VM."""
+    process uses to talk to a manager on another machine/VM.
 
-    def __init__(self, addr: str, name: str = "fuzzer"):
-        self.client = RpcClient(addr)
+    Every call retries transport failures (connection closed/reset and
+    injected faults) with jittered exponential backoff and a
+    restart-aware reconnect: a fresh socket is dialed and — because a
+    restarted manager has lost this fuzzer's registration — ``connect``
+    is replayed before the failed method is retried.  Failures are
+    counted (``rpc_errors_total`` / ``rpc_retries_total`` /
+    ``rpc_reconnects_total``) and logged, never swallowed."""
+
+    RETRYABLE = (OSError, RpcConnError, InjectedFault)
+
+    def __init__(self, addr: str, name: str = "fuzzer",
+                 max_retries: int = 5, base_backoff: float = 0.1,
+                 max_backoff: float = 5.0, seed: int = 0):
+        self.addr = addr
         self.name = name
+        self.max_retries = max_retries
+        self.base_backoff = base_backoff
+        self.max_backoff = max_backoff
+        self._rng = random.Random(seed)
+        reg = get_registry()
+        self._c_errors = reg.counter(
+            "rpc_errors_total",
+            help="manager RPC calls that failed (counted per attempt, "
+                 "before any retry succeeds)")
+        self._c_retries = reg.counter(
+            "rpc_retries_total",
+            help="manager RPC attempts retried after a transport failure")
+        self._c_reconnects = reg.counter(
+            "rpc_reconnects_total",
+            help="manager RPC sockets re-dialed (restart-aware: connect "
+                 "is replayed before the failed call)")
+        self.client = RpcClient(addr)
+
+    def _call(self, method: str, **args):
+        delay = self.base_backoff
+        for attempt in range(self.max_retries + 1):
+            try:
+                # distinct from the engine-level "rpc.poll" site: a plan
+                # targeting the transport exercises THIS retry loop
+                _faults.fire(f"rpc.transport.{method}")
+                return self.client.call(method, **args)
+            except self.RETRYABLE as e:
+                self._c_errors.inc()
+                # transport-scoped site: the engine-level "rpc_poll" /
+                # "rpc_new_input" sites count logical failures exactly
+                # once; these count per attempt
+                count_error("rpc_transport_" + method, e)
+                if attempt == self.max_retries:
+                    raise
+                self._c_retries.inc()
+                time.sleep(delay * (0.5 + self._rng.random()))
+                delay = min(delay * 2.0, self.max_backoff)
+                self._reconnect(method)
+
+    def _reconnect(self, method: str) -> None:
+        """Dial a fresh socket; on success re-register with ``connect``
+        (a restarted manager forgot us).  A failed redial keeps the old
+        client — the next attempt fails fast and backs off again."""
+        old = self.client
+        try:
+            client = RpcClient(self.addr)
+        except OSError as e:
+            count_error("rpc_reconnect", e)
+            return
+        try:
+            old.close()
+        except OSError:
+            pass
+        self.client = client
+        self._c_reconnects.inc()
+        if method != "connect":
+            try:
+                self.client.call("connect", name=self.name)
+            except (OSError, RpcError) as e:
+                count_error("rpc_reconnect", e)
 
     def connect(self):
-        return self.client.call("connect", name=self.name)
+        return self._call("connect", name=self.name)
 
     def new_input(self, prog_text: str, call_index: int, signal, cover):
-        return self.client.call("new_input", name=self.name,
-                                prog_text=prog_text, call_index=call_index,
-                                signal=list(signal), cover=list(cover))
+        return self._call("new_input", name=self.name,
+                          prog_text=prog_text, call_index=call_index,
+                          signal=list(signal), cover=list(cover))
 
     def poll(self, stats, need_candidates: bool, new_signal=()):
-        return self.client.call("poll", name=self.name, stats=stats,
-                                need_candidates=need_candidates,
-                                new_signal=list(new_signal))
+        return self._call("poll", name=self.name, stats=stats,
+                          need_candidates=need_candidates,
+                          new_signal=list(new_signal))
 
     def close(self) -> None:
         self.client.close()

@@ -79,6 +79,7 @@ class CorpusArena:
             cid, sval, data = (jax.device_put(x, sharding)
                                for x in (cid, sval, data))
         self.cid, self.sval, self.data = cid, sval, data
+        self._sharding = sharding
         self._lock = threading.Lock()
         self._append_fn = jax.jit(_append_row, donate_argnums=(0, 1, 2))
 
@@ -134,6 +135,30 @@ class CorpusArena:
                 jnp.asarray(np.asarray(sval_row), jnp.uint64),
                 jnp.asarray(np.asarray(data_row), jnp.uint8))
             return row
+
+    def restore(self, cid, sval, data, *, size: int, cursor: int,
+                evictions: int = 0) -> None:
+        """Replace the ring wholesale from a checkpoint (engine resume).
+        Shapes must match the configured capacity/format — the caller
+        validates before any state mutates (Fuzzer._apply_checkpoint)."""
+        cid = jnp.asarray(np.asarray(cid), jnp.int32)
+        sval = jnp.asarray(np.asarray(sval), jnp.uint64)
+        data = jnp.asarray(np.asarray(data), jnp.uint8)
+        for name, got, want in (("cid", cid, self.cid),
+                                ("sval", sval, self.sval),
+                                ("data", data, self.data)):
+            if got.shape != want.shape:
+                raise ValueError(
+                    f"arena restore {name} shape {got.shape} != "
+                    f"{want.shape}")
+        if self._sharding is not None:
+            cid, sval, data = (jax.device_put(x, self._sharding)
+                               for x in (cid, sval, data))
+        with self._lock:
+            self.cid, self.sval, self.data = cid, sval, data
+            self.size = min(max(int(size), 0), self.capacity)
+            self.cursor = int(cursor) % self.capacity
+            self.evictions = int(evictions)
 
     # ---- reads ----
 

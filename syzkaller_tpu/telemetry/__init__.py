@@ -70,3 +70,21 @@ def telemetry_dump_to(path: str):
 def set_spans_enabled(enabled: bool) -> None:
     """Global span opt-out (counters stay on — they are the wire stats)."""
     get_registry().spans_enabled = bool(enabled)
+
+
+def count_error(site: str, exc=None) -> None:
+    """Log + count a handled error: the replacement for bare
+    ``except Exception: pass``.  Bumps the aggregate ``errors_total``
+    plus a per-site ``errors_<site>_total`` counter (dynamic names —
+    tools/check_metrics exempts non-literal registrations), and logs at
+    verbosity 1 so failures are visible, never silent."""
+    reg = get_registry()
+    reg.counter(
+        "errors_total",
+        help="handled internal errors (per-site split: errors_<site>_total)"
+    ).inc()
+    reg.counter("errors_" + site + "_total").inc()
+    if exc is not None:
+        from ..utils.log import logf
+
+        logf(1, "error at %s: %s: %s", site, type(exc).__name__, exc)

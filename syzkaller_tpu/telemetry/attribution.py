@@ -154,6 +154,36 @@ class AttributionLedger:
             self._phases.clear()
             self._ops.clear()
 
+    # ---- checkpoint round-trip (engine/checkpoint.py) ----
+
+    def state(self) -> Dict[str, Dict]:
+        """Raw cell counts for the engine checkpoint (snapshot() derives
+        ratios and is lossy; this is the exact restorable form)."""
+        with self._lock:
+            return {
+                "phases": {p: [c.execs, c.new_signal, c.corpus_adds]
+                           for p, c in self._phases.items()},
+                "ops": {int(o): [c.execs, c.new_signal, c.corpus_adds]
+                        for o, c in self._ops.items()},
+            }
+
+    def load_state(self, st: Dict[str, Dict]) -> None:
+        """Replace the ledger wholesale from a checkpointed ``state()``.
+        NOTE: the ledger is process-global — restoring overwrites any
+        credit other in-process fuzzers accumulated (cross-restart
+        continuity is an open ROADMAP item)."""
+        with self._lock:
+            self._phases.clear()
+            self._ops.clear()
+            for p, (e, ns, ca) in st.get("phases", {}).items():
+                c = self._phase(p)
+                c.execs, c.new_signal, c.corpus_adds = \
+                    int(e), int(ns), int(ca)
+            for o, (e, ns, ca) in st.get("ops", {}).items():
+                c = self._op(int(o))
+                c.execs, c.new_signal, c.corpus_adds = \
+                    int(e), int(ns), int(ca)
+
 
 class Provenance:
     """One program's origin: phase + the operator indices that shaped it.

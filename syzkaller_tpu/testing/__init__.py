@@ -1,0 +1,10 @@
+"""Deterministic chaos tooling for the campaign-supervision layer.
+
+``faults`` holds the seeded fault-injection harness (FaultPlan): tests
+install a plan that kills executor envs, raises on manager RPC, and
+poisons device steps at chosen occurrences, and the production paths
+consult it through near-zero-cost module hooks.  Nothing here imports
+jax/numpy — installing no plan must cost one global read per hook site.
+"""
+
+from . import faults  # noqa: F401

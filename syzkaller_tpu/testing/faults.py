@@ -1,0 +1,123 @@
+"""Seeded fault-injection harness: deterministic chaos for the engine.
+
+Reference syzkaller earns its robustness claims by construction (VMs are
+disposable, the corpus persists, vmLoop reschedules) but has no way to
+*prove* them hermetically.  This module closes that gap: a ``FaultPlan``
+schedules faults at exact occurrence counts per *site* (or draws them at
+a seeded rate), tests ``install()`` it, and the production paths consult
+the plan through two hooks:
+
+    should_fire(site) -> bool   # caller implements the failure itself
+    fire(site)                  # raises InjectedFault when scheduled
+
+Sites in use (grep for the literals):
+
+    ``env.exec:<pid>``  — ipc Env/MockEnv exec_raw: the executor "dies"
+                          (real proc killed / mock reports failed), which
+                          the drain supervisor must survive by re-sharding
+                          the row across surviving envs;
+    ``rpc.poll``        — engine poll_manager (fired once per sync,
+                          whatever the manager type): one sync fails,
+                          the campaign must not;
+    ``rpc.transport.<method>`` — RemoteManager transport attempts (fired
+                          once per attempt): exercises the retry /
+                          reconnect loop specifically;
+    ``device.step``     — _DevicePipeline launch: the XLA step raises and
+                          the degradation ladder (retry -> recompile ->
+                          host fallback) must catch it.
+
+Hooks are NO-OPS when no plan is installed (one module-global read), so
+production binaries pay nothing.  Occurrence counting is per-site and
+1-based: ``fail_at("rpc.poll", 1)`` fails the first poll only.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class InjectedFault(RuntimeError):
+    """An error raised on purpose by an installed FaultPlan."""
+
+
+class FaultPlan:
+    """Deterministic fault schedule: explicit per-site occurrence indices
+    plus optional seeded random rates.  Thread-safe — the drain workers
+    hit ``env.exec:*`` sites concurrently."""
+
+    def __init__(self, seed: int = 0,
+                 rates: Optional[Dict[str, float]] = None):
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self._sched: Dict[str, set] = {}
+        self._rates: Dict[str, float] = dict(rates or {})
+        self._counts: Dict[str, int] = {}
+        self._fired: List[Tuple[str, int]] = []
+
+    def fail_at(self, site: str, *occurrences: int) -> "FaultPlan":
+        """Schedule failures at the given 1-based occurrence indices of
+        ``site``; returns self so plans read as one chained literal."""
+        self._sched.setdefault(site, set()).update(occurrences)
+        return self
+
+    def rate(self, site: str, p: float) -> "FaultPlan":
+        """Additionally fail ``site`` with probability ``p`` per
+        occurrence (seeded — the same plan replays identically)."""
+        self._rates[site] = p
+        return self
+
+    def should_fire(self, site: str) -> bool:
+        with self._lock:
+            n = self._counts.get(site, 0) + 1
+            self._counts[site] = n
+            hit = n in self._sched.get(site, ())
+            p = self._rates.get(site, 0.0)
+            if not hit and p > 0.0 and self._rng.random() < p:
+                hit = True
+            if hit:
+                self._fired.append((site, n))
+            return hit
+
+    def fired(self) -> List[Tuple[str, int]]:
+        """(site, occurrence) log of every fault this plan delivered."""
+        with self._lock:
+            return list(self._fired)
+
+    def count(self, site: str) -> int:
+        """How many times ``site`` has been consulted."""
+        with self._lock:
+            return self._counts.get(site, 0)
+
+
+_active: Optional[FaultPlan] = None
+
+
+def install(plan: Optional[FaultPlan]) -> None:
+    """Make ``plan`` the process-wide active plan (None to disarm)."""
+    global _active
+    _active = plan
+
+
+def clear() -> None:
+    install(None)
+
+
+def active() -> Optional[FaultPlan]:
+    return _active
+
+
+def should_fire(site: str) -> bool:
+    """Hook for call sites that implement the failure themselves (the
+    ipc env-death simulation).  No plan installed -> always False."""
+    p = _active
+    return p is not None and p.should_fire(site)
+
+
+def fire(site: str) -> None:
+    """Hook for call sites where a raised exception IS the failure mode
+    (RPC calls, device steps).  No plan installed -> no-op."""
+    p = _active
+    if p is not None and p.should_fire(site):
+        raise InjectedFault(site)

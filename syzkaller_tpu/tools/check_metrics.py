@@ -50,6 +50,18 @@ REQUIRED_METRICS = (
     # device health family (ISSUE 2)
     "device_batch_occupancy",
     "device_live_buffer_bytes",
+    # campaign supervision (ISSUE 4): checkpoint/resume, env supervisor,
+    # RPC retry, degradation ladder, visible-error accounting
+    "env_restarts_total",
+    "env_quarantined",
+    "env_watchdog_trips_total",
+    "env_kill_escalations_total",
+    "checkpoint_write_seconds",
+    "checkpoint_age_seconds",
+    "rpc_errors_total",
+    "rpc_retries_total",
+    "device_degraded_total",
+    "errors_total",
 )
 
 

@@ -1,0 +1,728 @@
+"""Campaign supervision tests (ISSUE 4): checkpoint/resume round-trips,
+the executor-env supervisor (backoff, quarantine, probes, watchdog), the
+device degradation ladder, RPC retry/reconnect, and the seeded
+fault-injection chaos harness that drives them all."""
+
+import io
+import os
+import subprocess
+import threading
+import time
+
+import pytest
+
+from syzkaller_tpu.engine import checkpoint as ckpt
+from syzkaller_tpu.engine.fuzzer import Fuzzer, FuzzerConfig
+from syzkaller_tpu.engine.supervisor import EnvSupervisor
+from syzkaller_tpu.prog import get_target
+from syzkaller_tpu.telemetry import get_registry
+from syzkaller_tpu.testing import faults
+from syzkaller_tpu.testing.faults import FaultPlan, InjectedFault
+
+
+@pytest.fixture(scope="module")
+def target():
+    return get_target("linux", "amd64")
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _counter(name):
+    m = get_registry().get(name)
+    return m.value if m is not None else 0
+
+
+def mk(target, **kw) -> Fuzzer:
+    kw.setdefault("mock", True)
+    kw.setdefault("use_device", False)
+    kw.setdefault("smash_mutations", 2)
+    return Fuzzer(target, FuzzerConfig(**kw))
+
+
+# --------------------------------------------------------------------- #
+# fault harness
+
+
+def test_fault_plan_schedule_and_determinism():
+    p1 = FaultPlan(seed=42, rates={"x": 0.5}).fail_at("a", 2, 4)
+    p2 = FaultPlan(seed=42, rates={"x": 0.5}).fail_at("a", 2, 4)
+    seq1 = [p1.should_fire("a") for _ in range(5)]
+    assert seq1 == [False, True, False, True, False]
+    rand1 = [p1.should_fire("x") for _ in range(50)]
+    [p2.should_fire("a") for _ in range(5)]
+    rand2 = [p2.should_fire("x") for _ in range(50)]
+    assert rand1 == rand2  # seeded: same plan replays identically
+    assert any(rand1) and not all(rand1)
+    assert ("a", 2) in p1.fired() and ("a", 4) in p1.fired()
+
+
+def test_fault_hooks_noop_without_plan():
+    assert faults.active() is None
+    assert not faults.should_fire("anything")
+    faults.fire("anything")  # must not raise
+    faults.install(FaultPlan().fail_at("site", 1))
+    with pytest.raises(InjectedFault):
+        faults.fire("site")
+    faults.fire("site")  # occurrence 2: not scheduled
+
+
+def test_mock_env_honors_injected_death(target):
+    from syzkaller_tpu.ipc import ExecOpts, MockEnv
+    from syzkaller_tpu.prog.generation import generate
+
+    env = MockEnv(target, pid=7)
+    p = generate(target, 1, 3)
+    faults.install(FaultPlan().fail_at("env.exec:7", 1))
+    _, infos, failed, _ = env.exec(ExecOpts(), p)
+    assert failed and not infos
+    _, infos, failed, _ = env.exec(ExecOpts(), p)  # next exec recovers
+    assert not failed and infos
+
+
+# --------------------------------------------------------------------- #
+# checkpoint format
+
+
+def test_checkpoint_roundtrip_bit_identical(tmp_path):
+    np = pytest.importorskip("numpy")
+    path = str(tmp_path / "a.ckpt")
+    arr = np.arange(4096, dtype=np.uint32) * np.uint32(2654435761)
+    state = {"bits": arr, "n": 7, "s": "x", "nested": {"k": [1, 2, 3]}}
+    n = ckpt.write_checkpoint(path, state)
+    assert n > 0 and not os.path.exists(path + ".tmp")
+    got = ckpt.read_checkpoint(path)
+    assert got["n"] == 7 and got["nested"] == {"k": [1, 2, 3]}
+    assert got["bits"].dtype == arr.dtype
+    assert np.array_equal(got["bits"], arr)
+
+
+def test_checkpoint_rejects_corruption(tmp_path):
+    path = str(tmp_path / "a.ckpt")
+    ckpt.write_checkpoint(path, {"v": list(range(100))})
+    blob = bytearray(open(path, "rb").read())
+    for mutate, name in (
+            (lambda b: b[:10], "truncated header"),
+            (lambda b: b"NOTMAGIC!!" + bytes(b[10:]), "bad magic"),
+            (lambda b: bytes(b[:-5]), "truncated payload"),
+            (lambda b: bytes(b[:40]) + bytes([b[40] ^ 0xFF])
+             + bytes(b[41:]), "flipped byte"),
+    ):
+        bad = str(tmp_path / "bad.ckpt")
+        open(bad, "wb").write(bytes(mutate(blob)))
+        with pytest.raises(ckpt.CheckpointError):
+            ckpt.read_checkpoint(bad)
+    with pytest.raises(ckpt.CheckpointError):
+        ckpt.read_checkpoint(str(tmp_path / "missing.ckpt"))
+
+
+def test_checkpoint_rejects_wrong_version(tmp_path):
+    path = str(tmp_path / "a.ckpt")
+    ckpt.write_checkpoint(path, {})
+    blob = bytearray(open(path, "rb").read())
+    blob[len(ckpt.MAGIC)] = 99  # version field LSB
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(ckpt.CheckpointError, match="version"):
+        ckpt.read_checkpoint(path)
+
+
+# --------------------------------------------------------------------- #
+# supervisor state machine
+
+
+def test_supervisor_backoff_and_quarantine():
+    clock = [0.0]
+    sup = EnvSupervisor(2, quarantine_threshold=3, base_backoff=0.1,
+                        max_backoff=1.0, probe_interval=5.0, seed=1,
+                        time_fn=lambda: clock[0])
+    assert sup.acquire(0)
+    sup.record_failure(0)
+    b1 = sup.last_backoff(0)
+    assert 0.05 <= b1 <= 0.15  # jittered [0.5, 1.5) x base
+    assert not sup.acquire(0)  # inside the backoff window
+    clock[0] += b1 + 0.001
+    assert sup.acquire(0)      # window elapsed
+    sup.record_failure(0)
+    assert sup.last_backoff(0) >= b1 * 0.5 * 2 * 0.5  # exponential-ish
+    sup.record_failure(0)      # third consecutive -> quarantine
+    assert sup.is_quarantined(0)
+    assert sup.quarantined_count() == 1
+    assert get_registry().get("env_quarantined").value == 1
+    assert sup.usable_elsewhere(0)       # env 1 survives
+    assert not sup.usable_elsewhere(1)   # env 0 is out
+    # quarantined: no rows except one probe per probe_interval
+    clock[0] += 100.0
+    assert sup.acquire(0)      # the probe
+    assert not sup.acquire(0)  # not a second one
+    sup.record_success(0)      # probe succeeded -> back in service
+    assert not sup.is_quarantined(0)
+    assert sup.failures(0) == 0
+    assert get_registry().get("env_quarantined").value == 0
+    sup.close()
+
+
+def test_supervisor_backoff_is_capped():
+    clock = [0.0]
+    sup = EnvSupervisor(1, base_backoff=0.1, max_backoff=0.5,
+                        quarantine_threshold=100, seed=3,
+                        time_fn=lambda: clock[0])
+    for _ in range(12):
+        sup.record_failure(0)
+    assert sup.last_backoff(0) <= 0.5 * 1.5
+    sup.close()
+
+
+@pytest.mark.chaos
+def test_watchdog_interrupts_wedged_env():
+    class _Wedged:
+        def __init__(self):
+            self._evt = threading.Event()
+            self.interrupted = False
+
+        def interrupt(self):
+            self.interrupted = True
+            self._evt.set()
+
+    before = _counter("env_watchdog_trips_total")
+    sup = EnvSupervisor(1, watchdog_seconds=0.05)
+    env = _Wedged()
+    t0 = time.monotonic()
+    with sup.guard(0, env):
+        assert env._evt.wait(3.0), "watchdog never fired"
+    assert env.interrupted
+    assert time.monotonic() - t0 < 1.0
+    assert _counter("env_watchdog_trips_total") == before + 1
+    sup.close()
+
+
+def test_watchdog_disabled_guard_is_noop():
+    sup = EnvSupervisor(1, watchdog_seconds=0.0)
+    with sup.guard(0, object()):
+        pass
+    assert sup._monitor is None  # no thread was ever started
+    sup.close()
+
+
+# --------------------------------------------------------------------- #
+# supervised drain fan-out
+
+
+class _FakeBatch:
+    """Minimal _DeviceBatch stand-in with per-row-identifiable streams."""
+
+    def __init__(self, n):
+        self.streams = [bytes([i]) for i in range(n)]
+        self.dropped = 0
+
+    def __len__(self):
+        return len(self.streams)
+
+    def op_mask(self, row):
+        return 1
+
+    def call_ids(self, row):
+        return [0, 1]
+
+    def decode(self, row):
+        return None
+
+
+class _ChaosEnv:
+    """Fake executor env that consults the fault plan like ipc does and
+    records which rows it executed (stream byte 0 = row id)."""
+
+    def __init__(self, pid, delay=0.002):
+        self.pid = pid
+        self.delay = delay
+        self.rows = []
+        self.fails = 0
+
+    def exec_raw(self, opts, data, call_ids):
+        time.sleep(self.delay)  # force genuine worker overlap
+        if faults.should_fire(f"env.exec:{self.pid}"):
+            self.fails += 1
+            return b"", [], True, False
+        self.rows.append(data[0])
+        return b"", [], False, False
+
+    def close(self):
+        pass
+
+
+@pytest.mark.chaos
+def test_drain_reshards_rows_exactly_once_after_env_kills(target):
+    """Kill 2 of 4 envs mid-batch: the supervised drain re-shards their
+    failed rows across the survivors and every row still executes
+    exactly once (the ISSUE 4 acceptance invariant)."""
+    plan = (FaultPlan(seed=1)
+            .fail_at("env.exec:1", 2, 3)
+            .fail_at("env.exec:2", 1, 2))
+    faults.install(plan)
+    before_restarts = _counter("env_restarts_total")
+    with mk(target, procs=4, env_base_backoff=0.005,
+            env_max_backoff=0.02, env_quarantine_threshold=2,
+            env_probe_interval=0.02) as f:
+        f.envs = [_ChaosEnv(i) for i in range(4)]
+        before_fuzz = f.stats["exec_fuzz"]
+        f._run_device_batch_inner(_FakeBatch(40))
+        rows = sorted(r for e in f.envs for r in e.rows)
+        assert rows == list(range(40)), "rows lost or duplicated"
+        fails = sum(e.fails for e in f.envs)
+        assert fails >= 1, "fault plan never fired"
+        # only a row's FIRST failure charges the env (repeat failures
+        # indict the program, not the env)
+        restarts = _counter("env_restarts_total") - before_restarts
+        assert 1 <= restarts <= fails
+        # every attempt that reached the executor is accounted
+        assert f.stats["exec_fuzz"] == before_fuzz + 40 + fails
+
+
+@pytest.mark.chaos
+def test_drain_survives_full_fleet_quarantine(target):
+    """Every env fails until quarantined, then recovers: un-quarantine
+    probes must still drain the batch (the last worker never leaves)."""
+    # both envs fail their first 2 execs -> both quarantine (threshold 2)
+    plan = (FaultPlan(seed=2)
+            .fail_at("env.exec:0", 1, 2)
+            .fail_at("env.exec:1", 1, 2))
+    faults.install(plan)
+    with mk(target, procs=2, env_base_backoff=0.002,
+            env_max_backoff=0.01, env_quarantine_threshold=2,
+            env_probe_interval=0.01, drain_max_attempts=10) as f:
+        f.envs = [_ChaosEnv(i, delay=0.001) for i in range(2)]
+        f._run_device_batch_inner(_FakeBatch(6))
+        rows = sorted(r for e in f.envs for r in e.rows)
+        assert rows == list(range(6))
+        # probes brought at least one env back
+        assert f.supervisor.quarantined_count() < 2
+
+
+def test_drain_drops_row_after_max_attempts(target):
+    """A row that fails on every env is dropped (counted), not retried
+    forever."""
+    plan = FaultPlan().rate("env.exec:0", 1.0).rate("env.exec:1", 1.0)
+    faults.install(plan)
+    before = _counter("drain_rows_dropped_total")
+    with mk(target, procs=2, env_base_backoff=0.001,
+            env_max_backoff=0.005, env_quarantine_threshold=100,
+            env_probe_interval=0.005, drain_max_attempts=2) as f:
+        f.envs = [_ChaosEnv(i, delay=0.0) for i in range(2)]
+        f._run_device_batch_inner(_FakeBatch(3))
+        assert all(not e.rows for e in f.envs)
+    assert _counter("drain_rows_dropped_total") == before + 3
+
+
+# --------------------------------------------------------------------- #
+# RPC supervision
+
+
+def test_poll_manager_survives_injected_rpc_failure(target):
+    faults.install(FaultPlan().fail_at("rpc.poll", 1))
+    before = _counter("errors_rpc_poll_total")
+    with mk(target) as f:
+        f.loop(iterations=20)
+        assert f.new_signal, "test needs un-synced signal"
+        kept = set(f.new_signal)
+        f.poll_manager()  # injected failure: logged + counted, not fatal
+        assert _counter("errors_rpc_poll_total") == before + 1
+        assert f.new_signal == kept, "new_signal lost on a failed sync"
+        f.poll_manager()  # next poll succeeds and clears
+        assert not f.new_signal
+
+
+def test_new_input_reports_retained_while_manager_down(target):
+    """A manager outage during triage must not kill the campaign; the
+    missed new_input reports are retained and re-sent once a poll
+    succeeds."""
+    from syzkaller_tpu.engine.fuzzer import ManagerConn
+
+    class FlakyMgr(ManagerConn):
+        def __init__(self):
+            self.inputs = []
+            self.down = True
+
+        def new_input(self, text, ci, sig, cover):
+            if self.down:
+                raise OSError("manager down")
+            self.inputs.append(text)
+
+    mgr = FlakyMgr()
+    f = Fuzzer(target, FuzzerConfig(mock=True, use_device=False,
+                                    smash_mutations=1), manager=mgr)
+    with f:
+        f.loop(iterations=30)  # triage lands inputs, reports all fail
+        assert f.corpus, "test needs corpus additions"
+        assert not mgr.inputs
+        assert f._pending_new_inputs
+        mgr.down = False
+        f.poll_manager()  # manager back: backlog drains
+        assert len(mgr.inputs) == len(f.corpus)
+        assert not f._pending_new_inputs
+
+
+def test_drain_program_failure_does_not_charge_env(target):
+    """STATUS_FAILED from a LIVE executor (call records present) is a
+    program property: the row is consumed without charging the env or
+    re-sharding."""
+    from syzkaller_tpu.ipc import CallInfo
+
+    class _FailingProgEnv:
+        def __init__(self, pid):
+            self.pid = pid
+            self.execs = 0
+
+        def exec_raw(self, opts, data, call_ids):
+            self.execs += 1
+            infos = [CallInfo(index=0, num=0, errno=1, executed=True,
+                              fault_injected=False)]
+            return b"", infos, True, False  # failed, but env replied
+
+        def close(self):
+            pass
+
+    before = _counter("env_restarts_total")
+    with mk(target, procs=2) as f:
+        f.envs = [_FailingProgEnv(i) for i in range(2)]
+        f._run_device_batch_inner(_FakeBatch(6))
+        assert sum(e.execs for e in f.envs) == 6  # no re-shard retries
+        assert f.supervisor.failures(0) == 0
+        assert f.supervisor.failures(1) == 0
+    assert _counter("env_restarts_total") == before
+
+
+@pytest.mark.chaos
+def test_remote_manager_reconnects_after_manager_restart():
+    """Transport failure -> jittered retry, fresh socket, and a replayed
+    connect (the restarted manager lost our registration)."""
+    from syzkaller_tpu.manager.rpc import RemoteManager, RpcServer
+
+    class H:
+        def __init__(self):
+            self.connects = []
+            self.polls = 0
+
+        def connect(self, name):
+            self.connects.append(name)
+            return {"ok": 1}
+
+        def poll(self, name, stats, need_candidates, new_signal=()):
+            self.polls += 1
+            return {"new_inputs": []}
+
+    h1 = H()
+    s1 = RpcServer(h1, port=0)
+    s1.start()
+    _, port = s1.addr.rsplit(":", 1)
+    rm = RemoteManager(s1.addr, name="f0", base_backoff=0.01,
+                       max_backoff=0.05)
+    rm.connect()
+    assert h1.connects == ["f0"]
+    before_rc = _counter("rpc_reconnects_total")
+    s1.stop()
+    h2 = H()
+    s2 = RpcServer(h2, port=int(port))
+    s2.start()
+    try:
+        rm.client._sock.close()  # the restart killed the old connection
+        assert rm.poll({}, need_candidates=False) == {"new_inputs": []}
+        assert h2.connects == ["f0"], "restart-aware re-register missing"
+        assert h2.polls == 1
+        assert _counter("rpc_reconnects_total") == before_rc + 1
+    finally:
+        rm.close()
+        s2.stop()
+
+
+def test_remote_manager_injected_fault_is_retried():
+    from syzkaller_tpu.manager.rpc import RemoteManager, RpcServer
+
+    class H:
+        def poll(self, name, stats, need_candidates, new_signal=()):
+            return {"new_inputs": []}
+
+        def connect(self, name):
+            return {}
+
+    s = RpcServer(H(), port=0)
+    s.start()
+    rm = RemoteManager(s.addr, name="x", base_backoff=0.005,
+                       max_backoff=0.01)
+    faults.install(FaultPlan().fail_at("rpc.transport.poll", 1))
+    before = _counter("rpc_retries_total")
+    try:
+        assert rm.poll({}, need_candidates=False) == {"new_inputs": []}
+        assert _counter("rpc_retries_total") == before + 1
+    finally:
+        rm.close()
+        s.stop()
+
+
+# --------------------------------------------------------------------- #
+# ipc close escalation
+
+
+class _WedgedProc:
+    """Popen stand-in that ignores the graceful quit until killed."""
+
+    def __init__(self):
+        self.killed = False
+        self.stdin = io.BytesIO()
+        self.stdout = None
+        self.waits = []
+
+    def poll(self):
+        return -9 if self.killed else None
+
+    def wait(self, timeout=None):
+        self.waits.append(timeout)
+        if not self.killed:
+            raise subprocess.TimeoutExpired("executor", timeout)
+        return -9
+
+    def kill(self):
+        self.killed = True
+
+
+class _Closeable:
+    def close(self):
+        pass
+
+
+def test_env_close_escalates_to_kill(tmp_path):
+    from syzkaller_tpu.ipc import Env
+
+    env = Env.__new__(Env)  # no toolchain in CI: skip __init__/build
+    env._proc = proc = _WedgedProc()
+    env._in_mm = env._out_mm = env._in_f = env._out_f = _Closeable()
+    env.workdir = str(tmp_path / "envdir")
+    os.makedirs(env.workdir)
+    before = _counter("env_kill_escalations_total")
+    env.close()
+    assert proc.killed, "wedged executor was not SIGKILLed"
+    assert len(proc.waits) >= 2, "no re-wait after kill: zombie leaks"
+    assert _counter("env_kill_escalations_total") == before + 1
+    assert env._proc is None
+
+
+# --------------------------------------------------------------------- #
+# engine checkpoint/resume
+
+
+def test_fuzzer_checkpoint_roundtrip_host_only(tmp_path, target):
+    from syzkaller_tpu.prog.encoding import serialize
+
+    cfg = dict(workdir=str(tmp_path), checkpoint_interval=0)
+    with mk(target, **cfg) as f:
+        f.loop(iterations=40)
+        f.save_checkpoint()
+        want_stats = dict(f.stats)
+        want_corpus = sorted(serialize(p) for p in f.corpus)
+        want_sig = set(f.max_signal)
+        want_depths = f.queue.depths()
+        want_draw = f.rng.rng.random()
+    with mk(target, resume=True, **cfg) as g:
+        assert dict(g.stats) == want_stats
+        assert sorted(serialize(p) for p in g.corpus) == want_corpus
+        assert g.max_signal == want_sig
+        assert g.queue.depths() == want_depths
+        # the RNG stream continues exactly where the dead engine stopped
+        assert g.rng.rng.random() == want_draw
+        g.loop(iterations=10)  # and the engine still fuzzes
+
+
+def test_fuzzer_checkpoint_age_and_metrics(tmp_path, target):
+    before_w = _counter("checkpoint_writes_total")
+    with mk(target, workdir=str(tmp_path), checkpoint_interval=0) as f:
+        f.loop(iterations=5)
+        f.save_checkpoint()
+        age = get_registry().get("checkpoint_age_seconds").value
+        assert 0 <= age < 60
+        assert _counter("checkpoint_writes_total") == before_w + 1
+        assert get_registry().get("checkpoint_write_seconds").count >= 1
+
+
+def test_fuzzer_rejects_corrupt_checkpoint_and_starts_fresh(
+        tmp_path, target):
+    cfg = dict(workdir=str(tmp_path), checkpoint_interval=0)
+    with mk(target, **cfg) as f:
+        f.loop(iterations=30)
+        f.save_checkpoint()
+        assert f.corpus
+    path = str(tmp_path / "engine.ckpt")
+    blob = bytearray(open(path, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF  # one flipped payload byte
+    open(path, "wb").write(bytes(blob))
+    before = _counter("checkpoint_rejected_total")
+    with mk(target, resume=True, **cfg) as g:
+        # clean rejection: fresh state, no crash, campaign runs
+        assert _counter("checkpoint_rejected_total") == before + 1
+        assert not g.corpus
+        g.loop(iterations=20)
+        assert g.stats["exec_total"] >= 20
+
+
+@pytest.mark.chaos
+def test_chaos_campaign_survives_and_resumes_bit_identical(
+        tmp_path, target):
+    """The ISSUE 4 acceptance scenario: a seeded FaultPlan kills 2 of 4
+    envs mid-campaign, fails one RPC sync, and poisons one device step —
+    the campaign completes, then a kill + --resume run restores a
+    bit-identical max-signal bitset and arena occupancy."""
+    pytest.importorskip("jax")
+    np = pytest.importorskip("numpy")
+
+    plan = (FaultPlan(seed=7)
+            .fail_at("env.exec:1", 3)
+            .fail_at("env.exec:2", 4)
+            .fail_at("rpc.poll", 1)
+            .fail_at("device.step", 2))
+    faults.install(plan)
+    rpc_before = _counter("errors_rpc_poll_total")
+    cfg = dict(mock=True, use_device=True, procs=4, device_batch=16,
+               device_period=4, smash_mutations=1, program_length=8,
+               workdir=str(tmp_path), checkpoint_interval=0,
+               env_base_backoff=0.005, env_max_backoff=0.02,
+               env_probe_interval=0.02)
+    with Fuzzer(target, FuzzerConfig(**cfg), seed=3) as f:
+        for _ in range(400):
+            f.step()
+            if f.stats.get("device_candidates", 0) >= 16:
+                break
+        assert f.stats["device_candidates"] >= 16
+        f.poll_manager()  # the injected sync failure
+        assert _counter("errors_rpc_poll_total") == rpc_before + 1
+        f.poll_manager()  # and the campaign syncs fine afterwards
+        # the poisoned device step was retried, not fatal
+        assert ("device.step", 2) in plan.fired()
+        assert not f._device.degraded
+        # exec ledger stayed exactly consistent through the chaos
+        parts = ("exec_gen", "exec_fuzz", "exec_candidate", "exec_triage",
+                 "exec_minimize", "exec_smash", "exec_hints")
+        assert f.stats["exec_total"] == sum(f.stats[k] for k in parts)
+        f.save_checkpoint()
+        want_bits = f._max_bits.copy()
+        want_sig = np.asarray(f._device._sig_shard).copy()
+        want_arena = [np.asarray(x).copy()
+                      for x in f._device.arena.tensors()]
+        want_occ = (f._device.arena.size, f._device.arena.cursor)
+    faults.clear()
+
+    # the "kill": the process state is gone; --resume restores it
+    with Fuzzer(target, FuzzerConfig(**{**cfg, "resume": True}),
+                seed=999) as g:
+        assert np.array_equal(g._max_bits, want_bits)
+        assert np.array_equal(np.asarray(g._device._sig_shard), want_sig)
+        got_arena = [np.asarray(x) for x in g._device.arena.tensors()]
+        for a, b in zip(got_arena, want_arena):
+            assert np.array_equal(a, b)
+        assert (g._device.arena.size, g._device.arena.cursor) == want_occ
+        g.loop(iterations=10)  # resumed campaign keeps fuzzing
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_soak_kill_resume_cycles_under_random_faults(tmp_path, target):
+    """Long-soak variant (excluded from tier-1): repeated kill/resume
+    cycles under a random-rate FaultPlan — signal state must be
+    monotone across every restart and the engine must never crash."""
+    pytest.importorskip("jax")
+    np = pytest.importorskip("numpy")
+
+    cfg = dict(mock=True, use_device=True, procs=3, device_batch=8,
+               device_period=4, smash_mutations=1, program_length=8,
+               workdir=str(tmp_path), checkpoint_interval=0,
+               env_base_backoff=0.002, env_max_backoff=0.01,
+               env_probe_interval=0.01)
+    prev_bits = None
+    for cycle in range(5):
+        faults.install(FaultPlan(seed=cycle, rates={
+            "env.exec:0": 0.02, "env.exec:1": 0.02, "env.exec:2": 0.02,
+            "rpc.poll": 0.2, "device.step": 0.01}))
+        with Fuzzer(target, FuzzerConfig(
+                **{**cfg, "resume": cycle > 0}), seed=cycle) as f:
+            if prev_bits is not None:
+                assert np.array_equal(f._max_bits, prev_bits), \
+                    f"cycle {cycle}: resumed bitset diverged"
+            f.loop(iterations=120)
+            f.poll_manager()
+            f.save_checkpoint()
+            prev_bits = f._max_bits.copy()
+            popcount = int(sum(int(x).bit_count() for x in prev_bits))
+        faults.clear()
+    assert popcount > 0, "soak never accumulated signal"
+
+
+# --------------------------------------------------------------------- #
+# device degradation ladder
+
+
+@pytest.mark.chaos
+def test_device_step_poison_is_retried(target):
+    pytest.importorskip("jax")
+    faults.install(FaultPlan().fail_at("device.step", 1))
+    before = _counter("device_step_retries_total")
+    cfg = FuzzerConfig(mock=True, use_device=True, device_batch=8,
+                       program_length=8, smash_mutations=1,
+                       device_period=4)
+    with Fuzzer(target, cfg) as f:
+        for _ in range(400):
+            f.step()
+            if f.stats.get("device_candidates", 0) >= 8:
+                break
+        assert f.stats["device_candidates"] >= 8
+        assert not f._device.degraded
+    assert _counter("device_step_retries_total") == before + 1
+
+
+@pytest.mark.chaos
+def test_device_ladder_degrades_to_host_path(target):
+    pytest.importorskip("jax")
+    cfg = FuzzerConfig(mock=True, use_device=True, device_batch=8,
+                       program_length=8, smash_mutations=1,
+                       device_period=4)
+    before = _counter("device_degraded_total")
+    with Fuzzer(target, cfg) as f:
+        f.loop(iterations=10)  # warm up, grow a corpus
+        faults.install(FaultPlan(rates={"device.step": 1.0}))
+        for _ in range(60):
+            f.step()
+            if f._device.degraded:
+                break
+        assert f._device.degraded, "ladder never exhausted"
+        assert _counter("device_degraded_total") == before + 1
+        assert _counter("device_step_recompiles_total") >= 1
+        faults.clear()
+        # host mutation path carries the campaign on
+        before_exec = f.stats["exec_total"]
+        f.loop(iterations=30)
+        assert f.stats["exec_total"] >= before_exec + 30
+
+
+# --------------------------------------------------------------------- #
+# namespace / satellite wiring
+
+
+def test_required_metrics_cover_supervision():
+    from syzkaller_tpu.tools.check_metrics import REQUIRED_METRICS, check
+
+    for name in ("env_restarts_total", "env_quarantined",
+                 "env_watchdog_trips_total", "env_kill_escalations_total",
+                 "checkpoint_write_seconds", "checkpoint_age_seconds",
+                 "rpc_errors_total", "rpc_retries_total",
+                 "device_degraded_total", "errors_total"):
+        assert name in REQUIRED_METRICS
+    assert check() == []  # every required name has a live registration
+
+
+def test_count_error_counts_and_splits_by_site():
+    from syzkaller_tpu.telemetry import count_error
+
+    before_total = _counter("errors_total")
+    before_site = _counter("errors_test_site_total")
+    count_error("test_site", ValueError("boom"))
+    assert _counter("errors_total") == before_total + 1
+    assert _counter("errors_test_site_total") == before_site + 1
