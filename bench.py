@@ -15,6 +15,14 @@ Configs (BASELINE.json "configs"):
                     (reference prog/hints.go)
   hub_sync        — corpus delta exchange between managers
                     (reference syz-hub; host-path: the DCN tier)
+  arena_sweep     — the e2e loop at arena capacities {256, 1024, 4096}:
+                    arena occupancy / evictions vs corpus yield per
+                    capacity (the ROADMAP arena_capacity-tuning item)
+
+The e2e-style configs report execs-per-new-input (yield efficiency)
+next to execs/sec: admission/scheduling wins show up as fewer wasted
+host executions per corpus addition even when the raw exec rate is
+unchanged.
 
 Honesty notes, also emitted in the JSON:
   - the "host" baselines are THIS REPO'S single-threaded Python
@@ -227,6 +235,20 @@ def bench_cover_merge(n_traces=10_000, pcs=64, nbits=1 << 22):
 E2E_DEVICE_PROCS = 4  # executor envs the device-pipeline drain fans over
 
 
+def _timed_loop(f, seconds: float):
+    """Run a warmed Fuzzer for `seconds`; returns (execs/sec, execs,
+    new_inputs) so callers can report execs-per-new-input (yield
+    efficiency) next to the raw rate."""
+    f.loop(iterations=30)  # warm up (compiles, first corpus entries)
+    n0 = f.stats["exec_total"]
+    ni0 = f.stats["new_inputs"]
+    t0 = time.perf_counter()
+    f.loop(duration=seconds)
+    dt = time.perf_counter() - t0
+    execs = f.stats["exec_total"] - n0
+    return execs / dt, execs, f.stats["new_inputs"] - ni0
+
+
 def bench_e2e(target, seconds=18.0):
     from syzkaller_tpu.engine.fuzzer import Fuzzer, FuzzerConfig
 
@@ -239,30 +261,61 @@ def bench_e2e(target, seconds=18.0):
             program_length=16, device_period=2, smash_mutations=4,
             procs=E2E_DEVICE_PROCS if use_device else 1)
         with Fuzzer(target, cfg) as f:
-            # warm up (compiles, first corpus entries)
-            f.loop(iterations=30)
-            n0 = f.stats["exec_total"]
-            t0 = time.perf_counter()
-            f.loop(duration=seconds)
-            dt = time.perf_counter() - t0
-            return ((f.stats["exec_total"] - n0) / dt,
-                    f.stats.get("device_candidates", 0))
+            return _timed_loop(f, seconds)
 
     cwd = os.getcwd()
     work = tempfile.mkdtemp(prefix="syztpu-bench-")
     os.chdir(work)
     try:
         try:
-            dev_rate, dev_cands = run(use_device=True, mock=False)
-            host_rate, _ = run(use_device=False, mock=False)
+            dev = run(use_device=True, mock=False)
+            host = run(use_device=False, mock=False)
             executor = "real"
         except Exception:
-            dev_rate, dev_cands = run(use_device=True, mock=True)
-            host_rate, _ = run(use_device=False, mock=True)
+            dev = run(use_device=True, mock=True)
+            host = run(use_device=False, mock=True)
             executor = "mock"
     finally:
         os.chdir(cwd)
-    return dev_rate, host_rate, executor
+    return dev, host, executor
+
+
+# ------------------------------------------------------------------ #
+# config[5]: arena capacity sweep (ROADMAP arena_capacity tuning)
+
+ARENA_SWEEP_CAPACITIES = (256, 1024, 4096)
+
+
+def bench_arena_sweep(target, seconds=6.0):
+    """The e2e device loop at each arena capacity, hermetic MockEnv fleet
+    (the sweep compares arena policies against themselves, not executor
+    speed): occupancy / evictions vs corpus yield per capacity.  Reads
+    the weighted-eviction counter via getattr so the same harness runs
+    against engines with and without weighted eviction."""
+    from syzkaller_tpu.engine.fuzzer import Fuzzer, FuzzerConfig
+
+    out = {}
+    for cap in ARENA_SWEEP_CAPACITIES:
+        cfg = FuzzerConfig(
+            mock=True, use_device=True, device_batch=256,
+            program_length=16, device_period=2, smash_mutations=4,
+            procs=E2E_DEVICE_PROCS, arena_capacity=cap)
+        with Fuzzer(target, cfg) as f:
+            rate, execs, new_inputs = _timed_loop(f, seconds)
+            arena = f._device.arena if f._device is not None else None
+            out[str(cap)] = {
+                "execs_per_sec": round(rate, 1),
+                "new_inputs": new_inputs,
+                "execs_per_new_input": round(execs / max(new_inputs, 1), 1),
+                "arena_occupancy": (round(arena.size / arena.capacity, 4)
+                                    if arena is not None else None),
+                "arena_evictions_total": (arena.evictions
+                                          if arena is not None else None),
+                "arena_weighted_evictions_total": (
+                    getattr(arena, "weighted_evictions", 0)
+                    if arena is not None else None),
+            }
+    return out
 
 
 # ------------------------------------------------------------------ #
@@ -409,13 +462,28 @@ def main(argv=None):
     run_config("hints_100k", _hints)
 
     def _e2e():
-        e2e_dev, e2e_host, executor = bench_e2e(target)
-        return {"device_pipeline": round(e2e_dev, 1),
-                "host_only": round(e2e_host, 1),
+        dev, host, executor = bench_e2e(target)
+        (dev_rate, dev_execs, dev_ni) = dev
+        (host_rate, host_execs, host_ni) = host
+        return {"device_pipeline": round(dev_rate, 1),
+                "host_only": round(host_rate, 1),
                 "unit": "execs/sec", "executor": executor,
-                "device_procs": E2E_DEVICE_PROCS}
+                "device_procs": E2E_DEVICE_PROCS,
+                # yield efficiency: admission/scheduling wins are visible
+                # here even when the raw exec rate is unchanged
+                "new_inputs": {"device": dev_ni, "host": host_ni},
+                "execs_per_new_input": {
+                    "device": round(dev_execs / max(dev_ni, 1), 1),
+                    "host": round(host_execs / max(host_ni, 1), 1)}}
 
     run_config("e2e_triage", _e2e)
+
+    def _arena_sweep():
+        res = bench_arena_sweep(target)
+        res["unit"] = "per-capacity e2e yield"
+        return res
+
+    run_config("arena_sweep", _arena_sweep)
 
     run_config("hub_sync", lambda: {
         "host": round(bench_hub(), 1), "unit": "progs/sec"})

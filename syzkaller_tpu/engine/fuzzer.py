@@ -63,6 +63,11 @@ _STAT_PHASE = {
     "exec_minimize": _attr.PHASE_TRIAGE,
 }
 
+# arena yield credit for a triaged corpus addition (on top of 1 point
+# per fresh max-signal PC) — an input good enough to join the corpus is
+# a strictly stronger signal than raw new PCs
+_CORPUS_ADD_CREDIT = 8.0
+
 
 @dataclass
 class FuzzerConfig:
@@ -80,8 +85,17 @@ class FuzzerConfig:
     sandbox: str = "none"
     device_period: int = 16             # consume a device batch every N steps
     # device-resident corpus arena rows (ops/arena.py): encoded programs
-    # stay on the chips; the ring overwrites the oldest beyond this
+    # stay on the chips; eviction beyond this prefers the lowest-yield
+    # row (FIFO among ties — see ops/arena.CorpusArena)
     arena_capacity: int = 1024
+    # ---- device-side candidate admission (ops/admission.py) ----
+    # recent-hash Bloom filter bits (rounded up to a power of two) and
+    # probe count; the filter resets once occupancy crosses the decay
+    # threshold (a brief dedup blind spot bounds the false-positive
+    # rate, which grows like occupancy**probes)
+    admission_bloom_bits: int = 1 << 20
+    admission_probes: int = 4
+    admission_bloom_decay: float = 0.5
     # device signal bitsets (sharded proxy set + host max-signal mirror):
     # sized like ops/cover.DEFAULT_BITS — a small mirror saturates with
     # collisions on a real corpus
@@ -500,7 +514,21 @@ class Fuzzer:
             _attr.PHASE_CANDIDATE if item.from_candidate
             else _attr.PHASE_MUTATE)
         self._ledger.record_new_signal(origin.phase, origin.ops, fresh)
-        if not self._add_corpus(item.prog, sig_list):
+        # yield-weighted scheduling feedback: new signal (and, below,
+        # the corpus addition) credits the arena row the candidate was
+        # sampled from, so the on-device weighted draw favors proven
+        # seeds and eviction spares them.  Accumulated into ONE credit
+        # (one donated device write), stamp-guarded against the row
+        # having been evicted+rewritten since the sample
+        src = getattr(origin, "row", -1)
+        credit = float(fresh)
+        added = self._add_corpus(item.prog, sig_list)
+        if added:
+            credit += _CORPUS_ADD_CREDIT
+        if credit > 0 and src >= 0 and self._device is not None:
+            self._device.credit_row(src, credit,
+                                    stamp=getattr(origin, "row_age", -1))
+        if not added:
             return  # minimized to an already-known program
         self.stats["new_inputs"] += 1
         self._m_new_inputs.inc()
@@ -782,7 +810,9 @@ class Fuzzer:
         Runs on drain worker threads — only thread-safe state may be
         touched (see _run_device_batch_inner)."""
         origin = Provenance(_attr.PHASE_MUTATE,
-                            ops_from_mask(batch.op_mask(row)))
+                            ops_from_mask(batch.op_mask(row)),
+                            row=batch.src_row(row),
+                            row_age=batch.src_age(row))
         stream = batch.streams[row]
         if stream is None:
             p = batch.decode(row)
@@ -864,6 +894,13 @@ class Fuzzer:
             if batch is not None:
                 self.stats["device_dropped_stale"] = self.stats.get(
                     "device_dropped_stale", 0) + batch.dropped
+                self.stats["device_deduped"] = self.stats.get(
+                    "device_deduped", 0) + batch.deduped
+                # wire stat: the RPC deployment's manager folds these
+                # into fleet_* counters, which the dashboard admission
+                # panel falls back to when the engine is remote
+                self.stats["device_admitted"] = self.stats.get(
+                    "device_admitted", 0) + len(batch)
                 if len(batch):
                     self.stats["device_batches"] += 1
                     self.stats["device_candidates"] += len(batch)
@@ -997,7 +1034,9 @@ class Fuzzer:
                     "signal": list(t.signal),
                     "from_candidate": t.from_candidate,
                     "minimized": t.minimized,
-                    "origin": ((t.origin.phase, list(t.origin.ops))
+                    "origin": ((t.origin.phase, list(t.origin.ops),
+                                getattr(t.origin, "row", -1),
+                                getattr(t.origin, "row_age", -1))
                                if t.origin is not None else None)}
 
         return {
@@ -1091,7 +1130,10 @@ class Fuzzer:
                 signal=list(d["signal"]),
                 from_candidate=bool(d.get("from_candidate")),
                 minimized=bool(d.get("minimized")),
-                origin=(Provenance(origin[0], origin[1])
+                origin=(Provenance(
+                    origin[0], origin[1],
+                    origin[2] if len(origin) > 2 else -1,
+                    origin[3] if len(origin) > 3 else -1)
                         if origin else None)))
         cand_items = [CandidateItem(deserialize(self.target, d["prog"]),
                                     minimized=bool(d.get("minimized")))
@@ -1166,14 +1208,20 @@ class _DevicePipeline:
     candidates, double-buffered so the TPU mutates batch N+1 while the
     executor fleet runs batch N (SURVEY §7 hard part #3).
 
-    The mutate/fingerprint/new-signal step is the SHARDED mesh step
-    (parallel/mesh.make_fuzz_step) over every visible device — data
-    parallelism over candidates on the ``fuzz`` axis, the word-sharded
-    proxy signal bitset on ``cover``, ICI collectives for fold and test.
-    One chip is just the 1-device mesh.  The ``fresh`` mask it returns
-    gates candidates BEFORE the host pays for emission/decode/execution —
-    stale mutants (all call fingerprints already seen) are dropped on
-    device (reference's SignalNew gate, pkg/cover/cover.go:104-117)."""
+    The sample/mutate/fingerprint/new-signal/admission step is the
+    SHARDED mesh step (parallel/mesh.make_arena_fuzz_step) over every
+    visible device — data parallelism over candidates on the ``fuzz``
+    axis, the word-sharded proxy signal bitset AND recent-hash Bloom
+    filter on ``cover``, ICI collectives for fold and test.  One chip is
+    just the 1-device mesh.  Row selection happens ON DEVICE from the
+    arena's yield-weighted cumulative table (nothing per-row crosses the
+    host boundary per launch), and two device-side gates fire BEFORE the
+    host pays for emission/decode/execution: the ``fresh`` mask drops
+    stale mutants (all call fingerprints already seen — the reference's
+    SignalNew gate, pkg/cover/cover.go:104-117) and the ``admit`` mask
+    drops duplicates (in-batch sort-and-compare + Bloom recent-hash
+    test, ops/admission.py).  Triage-confirmed yield credits back to the
+    sampled arena rows, closing the scheduling loop."""
 
     def __init__(self, target, cfg: FuzzerConfig):
         import jax
@@ -1199,17 +1247,32 @@ class _DevicePipeline:
         self.n_fuzz, self.n_cover = self.mesh.devices.shape
         # batch must divide the fuzz axis; round up
         self.B = -(-cfg.device_batch // self.n_fuzz) * self.n_fuzz
+        self._k_probes = max(int(cfg.admission_probes), 1)
+        self._bloom_decay = float(cfg.admission_bloom_decay)
         self._step, self._shardings = pmesh.make_arena_fuzz_step(
-            self.mesh, self.dt)
+            self.mesh, self.dt, batch=self.B, k_probes=self._k_probes)
         # the sharded bitset mapping requires power-of-two total bits
         # (parallel/mesh._shard_index); round up like the host mirror does
         nbits = 1 << (cfg.mirror_bits - 1).bit_length()
         nwords = max(nbits // 32, 32 * self.n_cover)
         self._sig_shard = jax.device_put(
             jnp.zeros(nwords, jnp.uint32), self._shardings["signal"])
+        # recent-hash admission Bloom filter (ops/admission.py), sharded
+        # like the signal bitset and donated through the step
+        bbits = 1 << (int(cfg.admission_bloom_bits) - 1).bit_length()
+        self._bloom_words = max(bbits // 32, 32 * self.n_cover)
+        self._bloom_bits = self._bloom_words * 32
+        self._bloom = jax.device_put(
+            jnp.zeros(self._bloom_words, jnp.uint32),
+            self._shardings["bloom"])
         self._key = jax.random.PRNGKey(1)
-        self._pick = np.random.default_rng(1)
         self._pending = None  # in-flight device computation (double buffer)
+        # arena age stamps snapshotted when the in-flight batch was
+        # launched: the yield-credit guard must compare against the ages
+        # the rows had AT SAMPLE TIME — a consume-time read would return
+        # the stamp of whatever program has since overwritten the row,
+        # letting the misattributed credit pass the guard
+        self._pending_ages = None
         self._sig_words = nwords
         self.degraded = False  # ladder exhausted: host mutation path only
         self.target = target
@@ -1241,6 +1304,25 @@ class _DevicePipeline:
             "device_degraded_total",
             help="device pipelines that exhausted the degradation ladder "
                  "and fell back to the host mutation path")
+        # device-side candidate admission (ISSUE 5): duplicates never
+        # reach the executor fleet, and the Bloom decay policy is
+        # auditable from the occupancy gauge
+        self._c_deduped = reg.counter(
+            "candidates_deduped_total",
+            help="device-mutated candidates dropped by admission "
+                 "(in-batch duplicate or recent-hash Bloom hit) before "
+                 "any host exec was paid")
+        self._c_admitted = reg.counter(
+            "candidates_admitted_total",
+            help="device-mutated candidates admitted to the executor "
+                 "fleet after the on-device dedup gate")
+        self._g_bloom_occ = reg.gauge(
+            "admission_bloom_occupancy",
+            help="fraction of recent-hash Bloom filter bits set (the "
+                 "filter resets past admission_bloom_decay)")
+        self._c_bloom_resets = reg.counter(
+            "admission_bloom_resets_total",
+            help="recent-hash Bloom filter decay resets")
 
         def _live_bytes():
             return sum(getattr(a, "nbytes", 0) for a in jax.live_arrays())
@@ -1274,8 +1356,7 @@ class _DevicePipeline:
         accelerator at reduced throughput instead of dying with it."""
         if self.degraded:
             return None
-        idx = self.arena.sample_indices(self._pick, self.B)
-        if idx is None:
+        if len(self.arena) == 0:
             return None
         from ..parallel import mesh as pmesh
 
@@ -1284,11 +1365,13 @@ class _DevicePipeline:
                 if rung == "recompile":
                     self._c_step_recompiles.inc()
                     self._step, self._shardings = \
-                        pmesh.make_arena_fuzz_step(self.mesh, self.dt)
-                return self._launch_once(idx)
+                        pmesh.make_arena_fuzz_step(
+                            self.mesh, self.dt, batch=self.B,
+                            k_probes=self._k_probes)
+                return self._launch_once()
             except Exception as e:
                 count_error("device_step", e)
-                self._heal_signal_shard()
+                self._heal_donated_buffers()
                 if rung == "try":
                     self._c_step_retries.inc()
         self.degraded = True
@@ -1299,78 +1382,139 @@ class _DevicePipeline:
                 "(step failed after retry + recompile)")
         return None
 
-    def _launch_once(self, idx):
+    def _launch_once(self):
         jax = self._jax
-        # the selection indices ([B] int32) are the ONLY per-launch H2D
-        # transfer: the batch is gathered out of the resident arena with
+        # nothing per-row crosses the host->device boundary per launch:
+        # row selection draws from the yield-weighted cumulative table ON
+        # DEVICE, the batch is gathered out of the resident arena with
         # jnp.take inside the jitted sharded step, and the signal bitset
-        # updates in place (donated)
+        # + admission Bloom filter update in place (donated).  The step
+        # reports which rows it drew (idx -> yield credit) and its
+        # admission verdict per mutant.
         with span("device.batch_stage"):
             _faults.fire("device.step")
-            self._key, kmut = jax.random.split(self._key)
-            idx_dev = jax.device_put(idx, self._shardings["batch"])
+            self._key, kstep = jax.random.split(self._key)
             a_cid, a_sval, a_data = self.arena.tensors()
-            cid, sval, data, self._sig_shard, fresh, op_mask = self._step(
-                kmut, idx_dev, a_cid, a_sval, a_data, self._sig_shard)
-        return cid, sval, data, fresh, op_mask
+            weights = self.arena.weights_tensor()
+            (idx, cid, sval, data, self._sig_shard, self._bloom, fresh,
+             admit, op_mask, bloom_pop) = self._step(
+                kstep, a_cid, a_sval, a_data, weights, self._sig_shard,
+                self._bloom)
+        return idx, cid, sval, data, fresh, admit, op_mask, bloom_pop
 
-    def _heal_signal_shard(self) -> None:
-        """A failed step may have consumed the donated proxy bitset;
-        rebuild it empty before the next rung.  Conservative: lost proxy
-        state only means some stale candidates re-test as fresh — extra
-        host work, never lost coverage (the exact sets live on the
-        host)."""
+    def _reset_bloom(self) -> None:
+        """Decay the recent-hash filter to empty (the periodic reset that
+        bounds its false-positive rate)."""
+        import jax.numpy as jnp
+
+        self._bloom = self._jax.device_put(
+            jnp.zeros(self._bloom_words, jnp.uint32),
+            self._shardings["bloom"])
+
+    def _heal_donated_buffers(self) -> None:
+        """A failed step may have consumed the donated proxy bitset and
+        admission Bloom filter; rebuild whichever died before the next
+        rung.  Conservative: lost proxy/filter state only means some
+        stale or duplicate candidates re-test as fresh — extra host
+        work, never lost coverage (the exact sets live on the host)."""
         jax = self._jax
         import jax.numpy as jnp
 
-        buf = self._sig_shard
-        try:
-            deleted = bool(buf.is_deleted())
-        except Exception:
-            deleted = False  # no introspection: assume still live
-        if deleted:
-            self._sig_shard = jax.device_put(
-                jnp.zeros(self._sig_words, jnp.uint32),
-                self._shardings["signal"])
+        def healed(buf, words, sharding):
+            try:
+                deleted = bool(buf.is_deleted())
+            except Exception:
+                deleted = False  # no introspection: assume still live
+            if not deleted:
+                return buf
+            return jax.device_put(jnp.zeros(words, jnp.uint32), sharding)
+
+        self._sig_shard = healed(self._sig_shard, self._sig_words,
+                                 self._shardings["signal"])
+        self._bloom = healed(self._bloom, self._bloom_words,
+                             self._shardings["bloom"])
+
+    def credit_row(self, row: int, amount: float,
+                   stamp: int = -1) -> None:
+        """Feed triage-confirmed yield (new-signal PCs, corpus adds)
+        back to the arena row the candidate was sampled from — the
+        weighted scheduler's feedback edge.  ``stamp`` is the row's age
+        at sample time; a mismatch means the row was evicted since and
+        the credit is dropped."""
+        self.arena.credit(row, amount, stamp=stamp)
 
     def candidates(self, corpus: List[Prog]) -> Optional["_DeviceBatch"]:
         """Return the previously launched batch — raw exec streams with a
         lazy per-row decoder — and launch the next one.
 
-        Stale rows (fresh mask false) are dropped here, before the host
-        pays for emission; the fast host boundary (prog/execgen.py) then
-        emits executor wire bytes straight from the tensors (~20x the
-        decode_prog walk), and a Prog tree is only materialized for rows
-        the engine actually wants to triage."""
+        Stale rows (fresh mask false) and admission-rejected rows
+        (in-batch duplicates, recent-hash Bloom hits) are dropped here,
+        before the host pays for emission or an executor round-trip; the
+        fast host boundary (prog/execgen.py) then emits executor wire
+        bytes straight from the tensors (~20x the decode_prog walk), and
+        a Prog tree is only materialized for rows the engine actually
+        wants to triage."""
         import numpy as np
 
-        done = self._pending
+        done, done_ages = self._pending, self._pending_ages
         self._pending = self._launch()
+        # snapshot the age stamps the instant the new batch launches
+        # (same thread: no append can interleave) — these are the
+        # sample-time stamps its eventual yield credits must carry
+        self._pending_ages = (self.arena.ages.copy()
+                              if self._pending is not None else None)
         if done is None:
             return None
-        cid, sval, data, fresh, op_mask = (np.asarray(x) for x in done)
-        keep = np.nonzero(fresh)[0]
+        (idx, cid, sval, data, fresh, admit,
+         op_mask, bloom_pop) = (np.asarray(x) for x in done)
+        fresh = fresh.astype(bool)
+        admit = admit.astype(bool)
         total = int(cid.shape[0])
-        dropped = int(total - keep.size)
+        stale = int(np.count_nonzero(~fresh))
+        deduped = int(np.count_nonzero(fresh & ~admit))
+        keep = np.nonzero(fresh & admit)[0]
         self._g_occupancy.set(keep.size / total if total else 0.0)
-        if keep.size < cid.shape[0]:
+        if deduped:
+            self._c_deduped.inc(deduped)
+        if keep.size:
+            self._c_admitted.inc(int(keep.size))
+        # Bloom decay: reset once the filter saturates past the target
+        # occupancy (FP rate ~ occupancy**k — at 0.5 with k=4 that is
+        # ~6%, each FP costing only one skipped-but-novel candidate)
+        occ = float(bloom_pop) / float(self._bloom_bits)
+        self._g_bloom_occ.set(occ)
+        if occ >= self._bloom_decay:
+            self._reset_bloom()
+            self._c_bloom_resets.inc()
+        if keep.size < total:
             cid, sval, data = cid[keep], sval[keep], data[keep]
-            op_mask = op_mask[keep]
+            op_mask, idx = op_mask[keep], idx[keep]
         batch = self._ProgBatch(call_id=cid, slot_val=sval, data=data)
         streams = self._execgen.emit_batch(batch)
-        return _DeviceBatch(self, batch, streams, dropped=dropped,
-                            op_masks=op_mask)
+        return _DeviceBatch(self, batch, streams, dropped=stale,
+                            deduped=deduped, op_masks=op_mask,
+                            src_rows=idx,
+                            src_ages=(done_ages[idx]
+                                      if done_ages is not None else None))
 
     # ---- checkpoint round-trip (engine/checkpoint.py) ----
 
     def checkpoint_state(self) -> dict:
         """Device-resident state a resume must restore bit-identically:
-        the corpus arena (rows + ring cursor/size/evictions), the sharded
-        proxy signal bitset, and both candidate-pipeline RNGs."""
+        the corpus arena (rows + ring cursor/size/evictions + yield
+        scores/ages), the sharded proxy signal bitset, the admission
+        Bloom filter, the device PRNG key, and — so resume never
+        re-mutates a batch of work — the in-flight double-buffered
+        candidate batch (staged rows, pre-compaction) with its
+        launch-time age-stamp snapshot."""
         import numpy as np
 
         jax = self._jax
         a_cid, a_sval, a_data = self.arena.tensors()
+        pending = None
+        if self._pending is not None:
+            pending = [np.asarray(jax.device_get(x))
+                       for x in self._pending]
         return {
             "arena": {
                 "cid": np.asarray(jax.device_get(a_cid)),
@@ -1379,10 +1523,17 @@ class _DevicePipeline:
                 "size": self.arena.size,
                 "cursor": self.arena.cursor,
                 "evictions": self.arena.evictions,
+                "weighted_evictions": self.arena.weighted_evictions,
+                "yields": self.arena.yields.copy(),
+                "ages": self.arena.ages.copy(),
+                "seq": self.arena._seq,
             },
             "sig_shard": np.asarray(jax.device_get(self._sig_shard)),
+            "bloom": np.asarray(jax.device_get(self._bloom)),
             "key": np.asarray(jax.device_get(self._key)),
-            "pick": self._pick.bit_generator.state,
+            "pending": pending,
+            "pending_ages": (self._pending_ages.copy()
+                             if self._pending_ages is not None else None),
         }
 
     def validate_state(self, st: dict) -> None:
@@ -1405,6 +1556,17 @@ class _DevicePipeline:
             raise ValueError(
                 f"checkpoint sig_shard shape {np.shape(st['sig_shard'])} "
                 f"!= configured {tuple(self._sig_shard.shape)}")
+        bloom = st.get("bloom")
+        if bloom is not None and \
+                tuple(np.shape(bloom)) != tuple(self._bloom.shape):
+            raise ValueError(
+                f"checkpoint bloom shape {np.shape(bloom)} != "
+                f"configured {tuple(self._bloom.shape)}")
+        pending = st.get("pending")
+        if pending is not None and len(pending) != 8:
+            raise ValueError(
+                f"checkpoint pending batch has {len(pending)} fields, "
+                f"expected 8")
 
     def restore_state(self, st: dict) -> None:
         import numpy as np
@@ -1413,17 +1575,39 @@ class _DevicePipeline:
         jax = self._jax
         self.validate_state(st)
         ar = st["arena"]
-        self.arena.restore(ar["cid"], ar["sval"], ar["data"],
-                           size=int(ar["size"]), cursor=int(ar["cursor"]),
-                           evictions=int(ar.get("evictions", 0)))
+        self.arena.restore(
+            ar["cid"], ar["sval"], ar["data"],
+            size=int(ar["size"]), cursor=int(ar["cursor"]),
+            evictions=int(ar.get("evictions", 0)),
+            weighted_evictions=int(ar.get("weighted_evictions", 0)),
+            yields=ar.get("yields"), ages=ar.get("ages"),
+            seq=int(ar.get("seq", 0)))
         self._sig_shard = jax.device_put(
             jnp.asarray(np.asarray(st["sig_shard"], np.uint32)),
             self._shardings["signal"])
+        bloom = st.get("bloom")
+        if bloom is not None:
+            self._bloom = jax.device_put(
+                jnp.asarray(np.asarray(bloom, np.uint32)),
+                self._shardings["bloom"])
+        else:
+            self._reset_bloom()  # pre-admission checkpoint: start empty
         self._key = jnp.asarray(st["key"])
-        pick = np.random.default_rng()
-        pick.bit_generator.state = st["pick"]
-        self._pick = pick
-        self._pending = None  # any in-flight pre-restore batch is stale
+        # (older checkpoints carry a "pick" host-RNG state from when row
+        # selection happened host-side; selection is on-device now, so
+        # the key is simply ignored)
+        # the in-flight double-buffered batch: restoring it means resume
+        # continues with the EXACT candidates that were staged when the
+        # checkpoint was written, instead of re-mutating one batch of
+        # work (host numpy is fine here — candidates() materializes with
+        # np.asarray either way), plus its launch-time age stamps so
+        # yield credits stay guarded across the restart
+        pending = st.get("pending")
+        self._pending = (tuple(np.asarray(x) for x in pending)
+                         if pending is not None else None)
+        ages = st.get("pending_ages")
+        self._pending_ages = (np.asarray(ages, np.int64).copy()
+                              if ages is not None else None)
 
 
 class _DeviceBatch:
@@ -1431,14 +1615,18 @@ class _DeviceBatch:
     row needs the decode fallback) plus lazy row decoding for triage."""
 
     def __init__(self, pipe: "_DevicePipeline", batch, streams,
-                 dropped: int = 0, op_masks=None):
+                 dropped: int = 0, deduped: int = 0, op_masks=None,
+                 src_rows=None, src_ages=None):
         import numpy as np
 
         self.pipe = pipe
         self.batch = batch
         self.streams = streams
         self.dropped = dropped  # stale rows gated off on device
+        self.deduped = deduped  # duplicate rows gated off by admission
         self.op_masks = op_masks  # [B] u32 per-row operator provenance
+        self.src_rows = src_rows  # [B] i32 arena row each mutant came from
+        self.src_ages = src_ages  # [B] i64 row age stamps (credit guard)
         self._decoded: Dict[int, Optional[Prog]] = {}
         # per-row stream call ids, vectorized once for the whole batch:
         # one numpy mask + one C-level tolist over [B, C] instead of a
@@ -1464,6 +1652,20 @@ class _DeviceBatch:
         if self.op_masks is None:
             return 0
         return int(self.op_masks[row])
+
+    def src_row(self, row: int) -> int:
+        """Arena row this candidate was sampled from (-1 when the batch
+        carries no sampling provenance) — the yield-credit target."""
+        if self.src_rows is None:
+            return -1
+        return int(self.src_rows[row])
+
+    def src_age(self, row: int) -> int:
+        """Age stamp of the source arena row at consume time (-1 without
+        provenance) — CorpusArena.credit drops stale-stamp credits."""
+        if self.src_ages is None:
+            return -1
+        return int(self.src_ages[row])
 
     def call_ids(self, row: int) -> List[int]:
         """Stream call ids: prelude mmap + the row's active calls (matches

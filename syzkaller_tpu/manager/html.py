@@ -370,6 +370,8 @@ class ManagerHttp:
                 ("signal growth", ("manager_signal", "max_signal_size"),
                  False),
                 ("exec rate /s", ("exec_total", "fleet_exec_total"), True),
+                ("admission rate /s", ("candidates_admitted_total",
+                                       "fleet_device_admitted"), True),
                 ("crash rate /s", ("manager_crashes", "crashes"), True),
                 ("corpus size", ("manager_corpus", "corpus_size"), False)):
             ts, vals = self._series(stored, *names)
@@ -388,6 +390,34 @@ class ManagerHttp:
         if health:
             parts.append("<h2>device health</h2>"
                          + _table(["gauge", "value"], health))
+
+        # candidate admission + yield efficiency (ISSUE 5): the number a
+        # perf PR is judged on is execs-per-new-input, not raw execs/sec.
+        # In the RPC deployment the engine-side counters never move in
+        # this process — the fleet_* counters folded from polled wire
+        # stats do, hence the fallbacks
+        def first_moving(*names):
+            return next((snap[n] for n in names if snap.get(n)), 0)
+
+        adm = [[k, _fmt_num(snap[k])] for k in (
+            "candidates_admitted_total", "fleet_device_admitted",
+            "candidates_deduped_total", "fleet_device_deduped",
+            "admission_bloom_occupancy", "admission_bloom_resets_total",
+            "arena_occupancy", "arena_evictions_total",
+            "arena_weighted_evictions_total") if k in snap]
+        execs = first_moving("exec_total", "fleet_exec_total")
+        adds = first_moving("new_inputs_total", "fleet_new_inputs")
+        if execs:
+            # "n/a" until the first input lands: execs/max(adds,1) would
+            # fabricate a value indistinguishable from a real ratio
+            adm.append(["execs_per_new_input",
+                        _fmt_num(round(execs / adds, 2)) if adds
+                        else "n/a (no inputs yet)"])
+            adm.append(["yield_per_kexec",
+                        _fmt_num(round(1000.0 * adds / execs, 4))])
+        if adm:
+            parts.append("<h2>admission &amp; yield</h2>"
+                         + _table(["metric", "value"], adm))
 
         sup = [[k, _fmt_num(snap[k])] for k in (
             "env_restarts_total", "env_quarantined",

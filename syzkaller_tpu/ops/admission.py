@@ -1,0 +1,175 @@
+"""Device-side candidate admission: hash, dedup, and Bloom-filter the
+mutated batch BEFORE the host pays an executor round-trip.
+
+BENCH_PR3_post put 64% of the e2e wall time in the executor drain at
+~25ms per host exec, while the device dispatch is milliseconds — yet
+program dedup happened only *after* execution, on the host at
+triage-add time (``hash_str(serialize(p))``).  Every duplicate or no-op
+mutation the TPU emitted still burned a full round-trip.  This module is
+the memoization move from the mutation-analysis literature ("Toward
+Speeding up Mutation Analysis by Memoizing Expensive Methods",
+arXiv:2102.11559; "Faster Mutation Analysis via Equivalence Modulo
+States", arXiv:1702.06689) applied at the host↔device boundary: identify
+redundant candidates with device arithmetic, so CPU envs only ever
+execute novel ones.
+
+Three pieces, all jit/vmap-friendly:
+
+  - ``row_hash`` — a 64-bit FNV/xor-fold hash over one encoded program
+    row ``(cid, sval, data)``: each field's words are avalanche-mixed
+    against their position (so permutations change the hash), xor-folded
+    to one word, and FNV-chained across fields.  ``row_hash_host`` is
+    the bit-identical numpy reference (parity-pinned by tests).
+  - ``inbatch_first_mask`` — in-batch duplicate masking via
+    sort-and-compare over the gathered ``[B]`` hash vector: exactly one
+    row per distinct hash keeps True.
+  - ``bloom_probes`` / ``bloom_test`` / ``bloom_add`` — a device-resident
+    recent-hash Bloom bitset reusing the ``ops/cover.py`` packed-bitset
+    machinery (``bitset_test`` / ``bitset_add``); ``k`` probe positions
+    per hash via the Kirsch–Mitzenmacher double-hash ``lo + i*hi``.  The
+    filter decays by periodic reset (the engine zeroes it past a target
+    occupancy), trading a bounded false-positive rate — a fresh
+    candidate occasionally skipped, never a lost *corpus* entry, since
+    exact dedup still runs at triage-add — for O(1) memory.
+
+The sharded (word-range over the ``cover`` axis) counterpart of the
+Bloom test/update lives in ``parallel/mesh.fold_admission``, next to the
+signal-bitset collectives it mirrors.
+"""
+
+from __future__ import annotations
+
+from . import ensure_x64  # noqa: F401
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import cover
+
+U32 = jnp.uint32
+U64 = jnp.uint64
+
+# splitmix64 finalizer constants (same family as mesh.call_fingerprints)
+_M1 = 0xBF58476D1CE4E5B9
+_M2 = 0x94D049BB133111EB
+FNV64_OFFSET = 0xCBF29CE484222325
+FNV64_PRIME = 0x100000001B3
+
+# per-field domain-separation salts (field order must not commute)
+_SALT_CID = 0x9E3779B97F4A7C15
+_SALT_SVAL = 0xC2B2AE3D27D4EB4F
+_SALT_DATA = 0x165667B19E3779F9
+
+BLOOM_PROBES = 4  # k hash functions; FP rate ~ occupancy**k
+DEFAULT_BLOOM_BITS = 1 << 20  # 128 KiB of device memory
+
+
+def _mix(h):
+    """splitmix64 avalanche (device)."""
+    h = (h ^ (h >> 30)) * U64(_M1)
+    h = (h ^ (h >> 27)) * U64(_M2)
+    return h ^ (h >> 31)
+
+
+def row_hash(cid, sval, data):
+    """64-bit hash of ONE encoded program row: cid [C] i32, sval [C, S]
+    u64, data [C, D] u8 -> u64 scalar.  vmap over the batch axis; every
+    op is elementwise + one xor reduction, so the vmapped form is a
+    single fused kernel, not a per-row scan."""
+
+    def fold(h, x, salt):
+        x = jnp.asarray(x).astype(U64).reshape(-1)
+        idx = jnp.arange(x.shape[0], dtype=U64)
+        w = _mix(x ^ _mix(idx + U64(salt)))
+        folded = jnp.bitwise_xor.reduce(w)
+        return _mix((h * U64(FNV64_PRIME)) ^ folded)
+
+    h = U64(FNV64_OFFSET)
+    h = fold(h, cid, _SALT_CID)
+    h = fold(h, sval, _SALT_SVAL)
+    h = fold(h, data, _SALT_DATA)
+    return h
+
+
+def _mix_host(h):
+    h = (h ^ (h >> np.uint64(30))) * np.uint64(_M1)
+    h = (h ^ (h >> np.uint64(27))) * np.uint64(_M2)
+    return h ^ (h >> np.uint64(31))
+
+
+def row_hash_host(cid, sval, data) -> int:
+    """Bit-identical numpy reference of ``row_hash`` (parity tests; NOT
+    on the launch path — the guard test patches it to prove that)."""
+    with np.errstate(over="ignore"):
+        h = np.uint64(FNV64_OFFSET)
+        for x, salt in ((cid, _SALT_CID), (sval, _SALT_SVAL),
+                        (data, _SALT_DATA)):
+            x = np.asarray(x).astype(np.uint64).reshape(-1)
+            idx = np.arange(x.shape[0], dtype=np.uint64)
+            w = _mix_host(x ^ _mix_host(idx + np.uint64(salt)))
+            folded = np.bitwise_xor.reduce(w) if w.size else np.uint64(0)
+            h = _mix_host((h * np.uint64(FNV64_PRIME)) ^ folded)
+        return int(h)
+
+
+def inbatch_first_mask(hashes):
+    """[B] u64 -> [B] bool: True on exactly one row per distinct hash
+    (sort-and-compare; jnp sorts are stable, so the keeper is the first
+    occurrence in batch order)."""
+    h = jnp.asarray(hashes, U64)
+    order = jnp.argsort(h)
+    s = h[order]
+    dup = jnp.concatenate(
+        [jnp.zeros((1,), bool), s[1:] == s[:-1]])
+    return jnp.zeros(h.shape, bool).at[order].set(~dup)
+
+
+def bloom_probes(hashes, k: int = BLOOM_PROBES):
+    """[...] u64 -> [..., k] u32 probe signals (Kirsch–Mitzenmacher
+    ``lo + i*hi`` with hi forced odd).  The probes feed the same packed
+    bitset ops the signal sets use — ``cover.bitset_test/add`` eagerly,
+    ``mesh.fold_admission`` inside the sharded step."""
+    h = jnp.asarray(hashes, U64)
+    lo = (h & U64(0xFFFFFFFF)).astype(U32)
+    hi = ((h >> U64(32)).astype(U32)) | U32(1)
+    i = jnp.arange(k, dtype=U32)
+    return lo[..., None] + i * hi[..., None]
+
+
+def bloom_test(bitset, hashes, k: int = BLOOM_PROBES):
+    """Which hashes are (probably) already in the filter?  True only when
+    ALL k probe bits are set — the classic Bloom membership test."""
+    hit = cover.bitset_test(bitset, bloom_probes(hashes, k))
+    return jnp.all(hit, axis=-1)
+
+
+def bloom_add(bitset, hashes, k: int = BLOOM_PROBES):
+    """Scatter all k probe bits of every hash into the filter."""
+    return cover.bitset_add(bitset, bloom_probes(hashes, k).reshape(-1))
+
+
+def bloom_occupancy(bitset) -> jnp.ndarray:
+    """Fraction of filter bits set (drives the decay/reset policy and the
+    ``admission_bloom_occupancy`` gauge)."""
+    nbits = bitset.shape[-1] * 32
+    return cover.bitset_count(bitset).astype(jnp.float32) / nbits
+
+
+def make_bloom(nbits: int = DEFAULT_BLOOM_BITS):
+    """Fresh all-zero Bloom bitset ([nbits/32] u32, power-of-two bits —
+    the same layout constraint as the signal bitsets)."""
+    nbits = 1 << (int(nbits) - 1).bit_length()
+    return cover.make_bitset(nbits)
+
+
+def admit_mask(bloom, hashes, k: int = BLOOM_PROBES):
+    """Eager single-device admission: (admit [B] bool, new bloom).
+    A row is admitted iff it is the first of its hash in this batch AND
+    its hash is not (probably) in the recent-hash filter.  ALL hashes are
+    then added — a rejected duplicate must stay remembered.  The sharded
+    launch path computes the same thing inside the fuzz step via
+    ``mesh.fold_admission``; this entry is for tests and host tooling."""
+    first = inbatch_first_mask(hashes)
+    seen = bloom_test(bloom, hashes, k)
+    return first & ~seen, bloom_add(bloom, hashes, k)

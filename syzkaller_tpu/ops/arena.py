@@ -11,19 +11,27 @@ device tensors
     cid  [cap, C]     int32    syscall id per call slot (-1 = empty)
     sval [cap, C, S]  uint64   template slot values
     data [cap, C, D]  uint8    per-call copyin arena image
+    w    [cap]        uint32   per-row sampling weight (yield-derived)
 
 appended to by a jitted donated single-row ``.at[row].set`` (the only
 per-add transfer is the one encoded program) and sampled *inside* the
-sharded fuzz step with ``jnp.take`` (parallel/mesh.make_arena_fuzz_step)
-— so the only per-launch H2D transfer is the [B] int32 selection-index
-vector.  This is the memoization move from "Toward Speeding up Mutation
-Analysis by Memoizing Expensive Methods": encode once, stay resident.
+sharded fuzz step (parallel/mesh.make_arena_fuzz_step) — the steady-state
+launch ships no per-row host data at all.  This is the memoization move
+from "Toward Speeding up Mutation Analysis by Memoizing Expensive
+Methods": encode once, stay resident.
 
-Eviction is a ring (FIFO overwrite): once ``size == capacity`` the cursor
-wraps and the oldest encoded program is overwritten, so week-long
-campaigns stay memory-bounded.  Occupancy / evictions / resident bytes
-are exported as the ``arena_*`` gauge family (tools/check_metrics.py
-requires them to stay registered).
+Scheduling is YIELD-WEIGHTED (ISSUE 5): every row carries a yield score
+fed back from the attribution ledger (new-signal PCs and corpus adds its
+sampled candidates earned).  Sampling draws from a cumulative-weight
+table — ``ops/rng.choose_weighted_from``, the prio.go:231-247 shape — on
+device inside the fuzz step (the host mirror here serves tests/tools),
+and eviction prefers the LOWEST-yield row over plain FIFO: the victim is
+the lexicographic (yield, age) minimum, so with no credit information it
+degrades to exactly the old FIFO ring, and with credit it protects
+proven seeds from being thrashed (``arena_weighted_evictions_total``
+counts the evictions where the policy actually diverged from FIFO).
+Occupancy / evictions / resident bytes stay exported as the ``arena_*``
+gauge family (tools/check_metrics.py requires them registered).
 """
 
 from __future__ import annotations
@@ -40,13 +48,36 @@ import numpy as np
 
 from ..telemetry import get_registry
 
+# weight formula shared by the host mirror and the device tensor:
+# w = 1 + min(round(yield), WEIGHT_CAP) for live rows, 0 for dead rows.
+# The +1 keeps every live row reachable; the cap bounds how hard a
+# jackpot seed can starve the rest of the ring.
+WEIGHT_CAP = (1 << 10) - 1
 
-def _append_row(a_cid, a_sval, a_data, row, cid, sval, data):
+
+def project_weights(yields, size: int) -> np.ndarray:
+    """THE yield->weight projection (one definition: the host mirror,
+    the single-row device writes, and checkpoint restore must all agree
+    bit-for-bit): w = 1 + min(round(yield), WEIGHT_CAP) for live rows,
+    0 for dead rows."""
+    w = np.minimum(np.floor(np.asarray(yields, np.float64) + 0.5),
+                   WEIGHT_CAP).astype(np.uint32) + 1
+    w[size:] = 0
+    return w
+
+
+def _append_row(a_cid, a_sval, a_data, a_w, row, cid, sval, data, w):
     """Jitted single-row write; the arena tensors are donated so XLA
     updates them in place instead of copying [cap, ...] per append."""
     return (a_cid.at[row].set(cid),
             a_sval.at[row].set(sval),
-            a_data.at[row].set(data))
+            a_data.at[row].set(data),
+            a_w.at[row].set(w))
+
+
+def _set_weight(a_w, row, w):
+    """Jitted donated single-row weight update (yield credit)."""
+    return a_w.at[row].set(w)
 
 
 class CorpusArena:
@@ -57,10 +88,10 @@ class CorpusArena:
     the point), the pre-append triple is consumed — a reader must not
     cache ``tensors()`` results across an append.  ``gather`` therefore
     dispatches its take under the lock.  The engine complies by
-    construction: appends and launches both happen on the scheduling
-    thread (drain workers never touch the arena), and a launch already
-    enqueued holds runtime-level buffer references, so an append cannot
-    invalidate in-flight device work.
+    construction: appends, launches, and yield credits all happen on the
+    scheduling thread (drain workers never touch the arena), and a
+    launch already enqueued holds runtime-level buffer references, so an
+    append cannot invalidate in-flight device work.
     """
 
     def __init__(self, capacity: int, fmt, sharding=None,
@@ -72,21 +103,35 @@ class CorpusArena:
         self.size = 0          # rows holding a real program
         self.cursor = 0        # next row to write (ring)
         self.evictions = 0     # overwrites of live rows
+        self.weighted_evictions = 0  # overwrites where policy != FIFO
+        # host-side yield bookkeeping: the eviction policy and the
+        # sampling-weight formula read these; the device weight tensor
+        # is the [cap] u32 projection kept in sync by append/credit
+        self.yields = np.zeros(cap, np.float64)
+        self.ages = np.zeros(cap, np.int64)   # append sequence stamp
+        self._seq = 0
         cid = jnp.full((cap, fmt.max_calls), -1, jnp.int32)
         sval = jnp.zeros((cap, fmt.max_calls, fmt.max_slots), jnp.uint64)
         data = jnp.zeros((cap, fmt.max_calls, fmt.arena), jnp.uint8)
+        w = jnp.zeros((cap,), jnp.uint32)
         if sharding is not None:
-            cid, sval, data = (jax.device_put(x, sharding)
-                               for x in (cid, sval, data))
+            cid, sval, data, w = (jax.device_put(x, sharding)
+                                  for x in (cid, sval, data, w))
         self.cid, self.sval, self.data = cid, sval, data
+        self.weights = w
         self._sharding = sharding
         self._lock = threading.Lock()
-        self._append_fn = jax.jit(_append_row, donate_argnums=(0, 1, 2))
+        self._append_fn = jax.jit(_append_row, donate_argnums=(0, 1, 2, 3))
+        self._set_w_fn = jax.jit(_set_weight, donate_argnums=(0,))
 
         reg = registry or get_registry()
         self._c_evictions = reg.counter(
             "arena_evictions_total",
             help="corpus-arena ring overwrites of live rows")
+        self._c_weighted_evictions = reg.counter(
+            "arena_weighted_evictions_total",
+            help="arena evictions where the lowest-yield victim differed "
+                 "from the FIFO (oldest-row) choice")
         ref = weakref.ref(self)
         self._gauge_fns = [
             (reg.gauge(
@@ -110,37 +155,103 @@ class CorpusArena:
 
     def resident_bytes(self) -> int:
         return sum(int(getattr(x, "nbytes", 0))
-                   for x in (self.cid, self.sval, self.data))
+                   for x in (self.cid, self.sval, self.data, self.weights))
 
     def __len__(self) -> int:
         return self.size
 
+    # ---- weights ----
+
+    def _row_weight(self, y: float) -> int:
+        """Scalar form of ``project_weights`` for a LIVE row (the jitted
+        single-row device writes)."""
+        return int(project_weights(np.asarray([y]), 1)[0])
+
+    def host_weights(self) -> np.ndarray:
+        """[cap] u32 host mirror of the device weight tensor (tests +
+        host-side sampling; the launch path never calls this)."""
+        with self._lock:
+            return project_weights(self.yields, self.size)
+
     # ---- writes ----
 
-    def append(self, cid_row, sval_row, data_row) -> int:
-        """Write one encoded program into the next ring slot; returns the
-        row index.  The H2D payload is the single row, the [cap, ...]
-        tensors update in place (donated)."""
-        with self._lock:
+    def _next_row(self) -> int:
+        """Pick the write slot (lock held): free slots first, then the
+        lexicographic (yield, age) minimum — lowest-yield victim, FIFO
+        among ties, so an uncredited arena evicts exactly like the old
+        ring while credited seeds survive."""
+        if self.size < self.capacity:
             row = self.cursor
             self.cursor = (self.cursor + 1) % self.capacity
-            if self.size == self.capacity:
-                self.evictions += 1
-                self._c_evictions.inc()
-            else:
-                self.size += 1
-            self.cid, self.sval, self.data = self._append_fn(
-                self.cid, self.sval, self.data, row,
+            self.size += 1
+            return row
+        victim = int(np.lexsort((self.ages, self.yields))[0])
+        if victim != int(np.argmin(self.ages)):
+            self.weighted_evictions += 1
+            self._c_weighted_evictions.inc()
+        self.evictions += 1
+        self._c_evictions.inc()
+        self.cursor = (victim + 1) % self.capacity
+        return victim
+
+    def append(self, cid_row, sval_row, data_row) -> int:
+        """Write one encoded program into the chosen slot; returns the
+        row index.  The H2D payload is the single row (+ its unit
+        weight); the [cap, ...] tensors update in place (donated)."""
+        with self._lock:
+            row = self._next_row()
+            self.yields[row] = 0.0
+            self.ages[row] = self._seq
+            self._seq += 1
+            (self.cid, self.sval, self.data,
+             self.weights) = self._append_fn(
+                self.cid, self.sval, self.data, self.weights, row,
                 jnp.asarray(np.asarray(cid_row), jnp.int32),
                 jnp.asarray(np.asarray(sval_row), jnp.uint64),
-                jnp.asarray(np.asarray(data_row), jnp.uint8))
+                jnp.asarray(np.asarray(data_row), jnp.uint8),
+                jnp.uint32(self._row_weight(0.0)))
             return row
 
+    def age_stamps(self, rows) -> np.ndarray:
+        """Append-sequence stamps of the given rows.  Credit guards must
+        capture stamps at SAMPLE/LAUNCH time (the engine snapshots
+        ``ages`` as each batch launches) — a consume-time read would
+        return the stamp of whatever program has since overwritten the
+        row, letting misattributed credit pass ``credit``'s guard."""
+        rows = np.asarray(rows, np.int64)
+        with self._lock:
+            return self.ages[rows].copy()
+
+    def credit(self, row: int, amount: float, stamp: int = -1) -> None:
+        """Credit yield back to a sampled source row (attribution-ledger
+        feedback: new-signal PCs / corpus adds its candidates earned).
+        ``stamp`` (an ``age_stamps`` value) guards against eviction
+        races: if the row was overwritten since the candidate was
+        sampled, the credit is dropped rather than misattributed.
+        Updates the host score and pushes the single projected weight to
+        the device tensor (donated in-place write — no full-[cap]
+        re-upload, no launch-path work)."""
+        row = int(row)
+        if amount <= 0 or not (0 <= row < self.capacity):
+            return
+        with self._lock:
+            if row >= self.size:
+                return  # row not live (stale provenance)
+            if stamp >= 0 and int(self.ages[row]) != int(stamp):
+                return  # row evicted+rewritten since the sample
+            self.yields[row] += float(amount)
+            self.weights = self._set_w_fn(
+                self.weights, row,
+                jnp.uint32(self._row_weight(self.yields[row])))
+
     def restore(self, cid, sval, data, *, size: int, cursor: int,
-                evictions: int = 0) -> None:
+                evictions: int = 0, yields=None, ages=None, seq: int = 0,
+                weighted_evictions: int = 0) -> None:
         """Replace the ring wholesale from a checkpoint (engine resume).
         Shapes must match the configured capacity/format — the caller
-        validates before any state mutates (Fuzzer._apply_checkpoint)."""
+        validates before any state mutates (Fuzzer._apply_checkpoint).
+        Yield scores restore bit-identically; the device weight tensor is
+        re-projected from them (deterministic)."""
         cid = jnp.asarray(np.asarray(cid), jnp.int32)
         sval = jnp.asarray(np.asarray(sval), jnp.uint64)
         data = jnp.asarray(np.asarray(data), jnp.uint8)
@@ -151,14 +262,32 @@ class CorpusArena:
                 raise ValueError(
                     f"arena restore {name} shape {got.shape} != "
                     f"{want.shape}")
+        new_yields = (np.asarray(yields, np.float64).copy()
+                      if yields is not None
+                      else np.zeros(self.capacity, np.float64))
+        new_ages = (np.asarray(ages, np.int64).copy()
+                    if ages is not None
+                    else np.zeros(self.capacity, np.int64))
+        if new_yields.shape != (self.capacity,) or \
+                new_ages.shape != (self.capacity,):
+            raise ValueError(
+                f"arena restore yields/ages shape {new_yields.shape}/"
+                f"{new_ages.shape} != ({self.capacity},)")
+        size = min(max(int(size), 0), self.capacity)
+        w = jnp.asarray(project_weights(new_yields, size))
         if self._sharding is not None:
-            cid, sval, data = (jax.device_put(x, self._sharding)
-                               for x in (cid, sval, data))
+            cid, sval, data, w = (jax.device_put(x, self._sharding)
+                                  for x in (cid, sval, data, w))
         with self._lock:
             self.cid, self.sval, self.data = cid, sval, data
-            self.size = min(max(int(size), 0), self.capacity)
+            self.weights = w
+            self.size = size
             self.cursor = int(cursor) % self.capacity
             self.evictions = int(evictions)
+            self.weighted_evictions = int(weighted_evictions)
+            self.yields = new_yields
+            self.ages = new_ages
+            self._seq = max(int(seq), int(new_ages.max()) + 1 if size else 0)
 
     # ---- reads ----
 
@@ -168,6 +297,13 @@ class CorpusArena:
         concurrency contract."""
         with self._lock:
             return self.cid, self.sval, self.data
+
+    def weights_tensor(self) -> jnp.ndarray:
+        """The live [cap] u32 device weight vector the sharded fuzz step
+        cumsums for on-device weighted sampling.  Same use-immediately
+        contract as ``tensors()``."""
+        with self._lock:
+            return self.weights
 
     def gather(self, idx) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
         """Device-side row gather (tests + host tooling; the production
@@ -182,10 +318,18 @@ class CorpusArena:
 
     def sample_indices(self, rng: np.random.Generator, n: int,
                        ) -> Optional[np.ndarray]:
-        """Uniform row indices over the live region ([B] int32 — the only
-        per-launch H2D transfer); None while the arena is empty."""
+        """Yield-weighted row indices over the live region ([n] int32):
+        a host cumulative-weight draw mirroring the on-device sampler
+        (ops/rng.choose_weighted_from semantics — uniform in [0, total)
+        then binary search, prio.go:231-247).  None while the arena is
+        empty.  Host tooling/fallback only: the steady-state launch
+        samples on device inside the fuzz step."""
         with self._lock:
             size = self.size
+            w = project_weights(self.yields, size)
         if size == 0:
             return None
-        return np.asarray(rng.integers(0, size, size=n), np.int32)
+        cw = np.cumsum(w[:size], dtype=np.uint64)
+        draws = rng.integers(0, int(cw[-1]), size=n)
+        return np.searchsorted(
+            cw, np.asarray(draws, np.uint64), side="right").astype(np.int32)

@@ -32,7 +32,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.dtables import DeviceTables
+from ..ops import admission as dadm
 from ..ops import mutation as dmut
+from ..ops import rng as drng
 from ..telemetry import get_registry, get_tracer
 
 # Device-health gauge: live jitted steps whose executable caches the
@@ -195,12 +197,18 @@ def _shard_index(sig_shard, sigs, shard_idx, n_shards):
     return mine, jnp.where(mine, word - lo, 0), (masked & U32(31))
 
 
-def fold_signals(sig_shard, sigs):
+def fold_signals(sig_shard, sigs, gate=None):
     """Inside shard_map: union executed signals (sharded over ``fuzz``,
     [b, K] u32 padded SENT) into the word-sharded global bitset; return
     (new sig_shard, fresh[b] bool = program produced signal not seen
     before anywhere).  Distributed SignalNew + SignalAdd
-    (/root/reference/pkg/cover/cover.go:160-182)."""
+    (/root/reference/pkg/cover/cover.go:160-182).
+
+    ``gate`` ([b] bool, optional) restricts the FOLD to gated rows while
+    the freshness TEST still covers every row: the arena step passes its
+    admission verdict, so a candidate rejected by a Bloom false positive
+    does not permanently mark its fingerprints as seen — after the
+    filter decays, an identical mutant re-tests fresh and executes."""
     j = jax.lax.axis_index(AXIS_COVER)
     n_shards = jax.lax.psum(1, AXIS_COVER)
     # --- test: per-shard hits, then combine over the cover axis ---
@@ -209,11 +217,37 @@ def fold_signals(sig_shard, sigs):
     fresh_local = jnp.any(mine & ~hit, axis=-1)
     fresh = jax.lax.psum(fresh_local.astype(jnp.int32), AXIS_COVER) > 0
     # --- fold: gather every fuzz-shard's signals, scatter my range ---
+    if gate is not None:
+        sigs = jnp.where(gate[..., None], jnp.asarray(sigs, U32), SENT)
     allsigs = jax.lax.all_gather(sigs, AXIS_FUZZ).reshape(-1)
     mine_all, lw_all, bit_all = _shard_index(sig_shard, allsigs, j, n_shards)
     mask = jnp.where(mine_all, U32(1) << bit_all, U32(0))
     sig_shard = jnp.bitwise_or.at(sig_shard, lw_all, mask, inplace=False)
     return sig_shard, fresh
+
+
+def fold_admission(bloom_shard, probes):
+    """Inside shard_map: Bloom-filter membership + update over the
+    word-range-sharded recent-hash bitset (the admission analogue of
+    ``fold_signals``).  ``probes`` is [b, K] u32 — the K probe signals of
+    each row's 64-bit hash (ops/admission.bloom_probes).  Returns
+    (new bloom_shard, seen[b] bool = ALL K probes were already set
+    somewhere across the cover shards).  Every row's probes are then
+    folded in — a rejected duplicate must stay remembered."""
+    j = jax.lax.axis_index(AXIS_COVER)
+    n_shards = jax.lax.psum(1, AXIS_COVER)
+    # --- test: any probe I own that is NOT set refutes membership ---
+    mine, lw, bit = _shard_index(bloom_shard, probes, j, n_shards)
+    hit = ((bloom_shard[lw] >> bit) & U32(1)) == 1
+    missing_local = jnp.any(mine & ~hit, axis=-1)
+    seen = jax.lax.psum(missing_local.astype(jnp.int32), AXIS_COVER) == 0
+    # --- fold: gather every fuzz-shard's probes, scatter my range ---
+    allp = jax.lax.all_gather(probes, AXIS_FUZZ).reshape(-1)
+    mine_all, lw_all, bit_all = _shard_index(bloom_shard, allp, j, n_shards)
+    mask = jnp.where(mine_all, U32(1) << bit_all, U32(0))
+    bloom_shard = jnp.bitwise_or.at(bloom_shard, lw_all, mask,
+                                    inplace=False)
+    return bloom_shard, seen
 
 
 # ---------------------------------------------------------------------- #
@@ -223,10 +257,17 @@ def fold_signals(sig_shard, sigs):
 def _step_body(dt: DeviceTables, rounds: int, key, cid, sval, data,
                sig_shard):
     """Per-device body under shard_map: mutate my candidate shard, proxy-
-    fingerprint it, fold+test against the sharded global signal set."""
+    fingerprint it, fold+test against the sharded global signal set.
+
+    The key is folded with the FUZZ index only: the batch outputs are
+    declared replicated over ``cover`` (out_specs P(fuzz)), so every
+    cover replica of a fuzz shard MUST compute the identical batch —
+    folding the cover index in would make each replica mutate different
+    programs while the word-sharded signal fold records each replica's
+    own phantoms (check_rep=False would silence the divergence, and
+    replica 0's data would silently win in the returned arrays)."""
     i = jax.lax.axis_index(AXIS_FUZZ)
-    j = jax.lax.axis_index(AXIS_COVER)
-    key = jax.random.fold_in(jax.random.fold_in(key, i), j)
+    key = jax.random.fold_in(key, i)
     cid, sval, data, op_mask = dmut.mutate_rows_stratified_traced(
         key, dt, cid, sval, data, rounds)
     sigs = jax.vmap(call_fingerprints)(cid, sval)      # [b, C] u32
@@ -271,52 +312,97 @@ def make_fuzz_step(mesh: Mesh, dt: DeviceTables, *, rounds: int = 2,
     return step, shardings
 
 
-def _arena_step_body(dt: DeviceTables, rounds: int, key, idx, a_cid,
-                     a_sval, a_data, sig_shard):
-    """Per-device body for the arena-resident launch path: gather my
-    candidate shard out of the replicated corpus arena with ``jnp.take``,
-    then mutate / fingerprint / fold exactly like ``_step_body``.  The
-    host ships only ``idx`` — the [B] selection vector — per launch."""
+def _arena_step_body(dt: DeviceTables, rounds: int, b_local: int,
+                     k_probes: int, key, a_cid, a_sval, a_data, weights,
+                     sig_shard, bloom_shard):
+    """Per-device body for the arena-resident launch path: draw my
+    candidate rows from the yield-weighted cumulative table ON DEVICE
+    (ops/rng.choose_weighted_from over the replicated weight vector),
+    gather them out of the resident arena with ``jnp.take``, mutate /
+    fingerprint / fold like ``_step_body``, then ADMISSION-gate the
+    mutants (ops/admission): in-batch dedup over the gathered hash
+    vector plus the sharded recent-hash Bloom filter.  The host ships
+    nothing per-row per launch — only the replicated PRNG key.
+
+    FUZZ-index fold only (see ``_step_body``): the drawn rows, mutants,
+    and admit verdicts are replicated over ``cover`` by construction, so
+    each cover shard's signal/Bloom word range is folded with the SAME
+    batch the host actually receives."""
     i = jax.lax.axis_index(AXIS_FUZZ)
-    j = jax.lax.axis_index(AXIS_COVER)
-    key = jax.random.fold_in(jax.random.fold_in(key, i), j)
+    key = jax.random.fold_in(key, i)
+    kidx, kmut = jax.random.split(key)
+    # yield-weighted sampling: cumsum + binary search per lane.  The
+    # cumsum runs on device — no host-side weight normalization (the
+    # launch-path guard test pins that).
+    cw = jnp.cumsum(weights.astype(jnp.uint64))
+    words = jax.random.bits(kidx, (b_local,), dtype=jnp.uint64)
+    idx = jnp.minimum(drng.choose_weighted_from(words, cw),
+                      weights.shape[0] - 1)
     cid = jnp.take(a_cid, idx, axis=0)
     sval = jnp.take(a_sval, idx, axis=0)
     data = jnp.take(a_data, idx, axis=0)
     cid, sval, data, op_mask = dmut.mutate_rows_stratified_traced(
-        key, dt, cid, sval, data, rounds)
+        kmut, dt, cid, sval, data, rounds)
+    # --- admission FIRST: hash, in-batch dedup, Bloom test+fold ---
+    h = jax.vmap(dadm.row_hash)(cid, sval, data)       # [b] u64
+    allh = jax.lax.all_gather(h, AXIS_FUZZ).reshape(-1)
+    first = jax.lax.dynamic_slice_in_dim(
+        dadm.inbatch_first_mask(allh), i * b_local, b_local)
+    bloom_shard, seen = fold_admission(
+        bloom_shard, dadm.bloom_probes(h, k_probes))
+    admit = first & ~seen
+    pop = jax.lax.psum(
+        jnp.sum(jax.lax.population_count(bloom_shard)), AXIS_COVER)
+    # freshness is TESTED for every row (the stale/dedup accounting
+    # needs both verdicts), but only admitted rows' fingerprints are
+    # FOLDED into the persistent proxy set — see fold_signals(gate=...)
     sigs = jax.vmap(call_fingerprints)(cid, sval)      # [b, C] u32
-    sig_shard, fresh = fold_signals(sig_shard, sigs)
-    return cid, sval, data, sig_shard, fresh, op_mask
+    sig_shard, fresh = fold_signals(sig_shard, sigs, gate=admit)
+    return (idx, cid, sval, data, sig_shard, bloom_shard, fresh, admit,
+            op_mask, pop)
 
 
-def make_arena_fuzz_step(mesh: Mesh, dt: DeviceTables, *, rounds: int = 2,
+def make_arena_fuzz_step(mesh: Mesh, dt: DeviceTables, *, batch: int,
+                         rounds: int = 2,
+                         k_probes: int = dadm.BLOOM_PROBES,
                          donate: bool = True):
     """Compile the arena-sampling sharded fuzz step over `mesh`.
 
     Returns (step, sharding) where
-      step(key, idx, arena_cid, arena_sval, arena_data, sig_shard)
-        -> (cid, sval, data, sig_shard, fresh, op_mask)
-    ``idx`` [B] int32 is batch-sharded over ``fuzz`` and is the only
-    per-launch host->device transfer; the arena tensors ([cap, ...],
-    ops/arena.CorpusArena) are replicated and sampled on device inside
-    the jitted step.  The signal bitset is donated (``donate``) so the
-    steady-state loop reuses one buffer; the arena tensors are NOT
-    donated — they persist across launches by design."""
+      step(key, arena_cid, arena_sval, arena_data, weights, sig_shard,
+           bloom)
+        -> (idx, cid, sval, data, sig_shard, bloom, fresh, admit,
+            op_mask, bloom_popcount)
+    The arena tensors ([cap, ...], ops/arena.CorpusArena) and the [cap]
+    u32 weight vector are replicated and sampled on device inside the
+    jitted step — the only per-launch host->device transfer is the
+    replicated PRNG key.  ``idx`` [B] i32 reports which arena row each
+    candidate was drawn from (provenance -> yield credit); ``admit``
+    [B] bool is the device-side admission verdict (in-batch-unique AND
+    not recently seen); ``bloom_popcount`` is the set-bit count of the
+    updated filter (drives the decay/reset policy without an extra
+    device round-trip).  ``batch`` must divide the fuzz axis.  The
+    signal bitset and the Bloom filter are donated (``donate``) so the
+    steady-state loop reuses the buffers; the arena tensors and weights
+    are NOT donated — they persist across launches by design."""
     pspec_batch = P(AXIS_FUZZ)
     pspec_sig = P(AXIS_COVER)
+    n_fuzz = mesh.devices.shape[0]
+    assert batch % n_fuzz == 0, (batch, n_fuzz)
 
-    body = partial(_arena_step_body, dt, rounds)
+    body = partial(_arena_step_body, dt, rounds, batch // n_fuzz, k_probes)
     mapped = shard_map(
         body, mesh=mesh,
-        in_specs=(P(), pspec_batch, P(), P(), P(), pspec_sig),
-        out_specs=(pspec_batch, pspec_batch, pspec_batch, pspec_sig,
-                   pspec_batch, pspec_batch))
-    jitted = jax.jit(mapped, donate_argnums=(5,) if donate else ())
+        in_specs=(P(), P(), P(), P(), P(), pspec_sig, pspec_sig),
+        out_specs=(pspec_batch, pspec_batch, pspec_batch, pspec_batch,
+                   pspec_sig, pspec_sig, pspec_batch, pspec_batch,
+                   pspec_batch, P()))
+    jitted = jax.jit(mapped, donate_argnums=(5, 6) if donate else ())
     step = _timed_step(jitted, "device.fuzz_step")
     shardings = {
         "batch": NamedSharding(mesh, pspec_batch),
         "signal": NamedSharding(mesh, pspec_sig),
+        "bloom": NamedSharding(mesh, pspec_sig),
         "replicated": NamedSharding(mesh, P()),
         "arena": NamedSharding(mesh, P()),
     }
@@ -328,9 +414,10 @@ def make_generate_step(mesh: Mesh, dt: DeviceTables, *, C: int):
     (seed corpus bootstrap, reference fuzzer.go:315)."""
 
     def body(key, dummy):
+        # fuzz-index fold only: outputs are replicated over ``cover``
+        # (see _step_body), so cover replicas must generate identically
         i = jax.lax.axis_index(AXIS_FUZZ)
-        j = jax.lax.axis_index(AXIS_COVER)
-        key = jax.random.fold_in(jax.random.fold_in(key, i), j)
+        key = jax.random.fold_in(key, i)
         return dmut.generate_rows(key, dt, B=dummy.shape[0], C=C)
 
     mapped = shard_map(

@@ -186,17 +186,27 @@ class AttributionLedger:
 
 
 class Provenance:
-    """One program's origin: phase + the operator indices that shaped it.
+    """One program's origin: phase + the operator indices that shaped it
+    + (for device-arena candidates) the arena row it was sampled from.
     Carried on TriageItems so the eventual corpus add credits the source
-    that produced the input, not the triage step that confirmed it."""
+    that produced the input, not the triage step that confirmed it — and
+    so new signal can be credited BACK to the sampled arena row (the
+    yield-weighted scheduler's feedback edge, ISSUE 5).  ``row`` is -1
+    when the input did not come from the device arena; ``row_age`` is
+    the arena's append-sequence stamp at sample time, so credit for a
+    row that was evicted and rewritten in the meantime is dropped
+    instead of misattributed (CorpusArena.credit)."""
 
-    __slots__ = ("phase", "ops")
+    __slots__ = ("phase", "ops", "row", "row_age")
 
-    def __init__(self, phase: str, ops: Iterable[int] = ()):
+    def __init__(self, phase: str, ops: Iterable[int] = (),
+                 row: int = -1, row_age: int = -1):
         self.phase = phase
         # dedupe, order-preserving: an exec is credited once per operator
         # *involved*, however many times the host mutate() loop drew it
         self.ops = tuple(dict.fromkeys(ops))
+        self.row = int(row)
+        self.row_age = int(row_age)
 
     def __repr__(self) -> str:
         names = [OP_NAMES[o] for o in self.ops if 0 <= o < len(OP_NAMES)]

@@ -45,6 +45,15 @@ REQUIRED_METRICS = (
     "arena_occupancy",
     "arena_evictions_total",
     "arena_resident_bytes",
+    # device-side candidate admission + yield-weighted scheduling
+    # (ISSUE 5): the dedup win and the Bloom decay policy must stay
+    # auditable, and weighted eviction must stay distinguishable from
+    # plain ring overwrite
+    "candidates_deduped_total",
+    "candidates_admitted_total",
+    "admission_bloom_occupancy",
+    "admission_bloom_resets_total",
+    "arena_weighted_evictions_total",
     # parallel executor fan-out: env utilization of the batch drain
     "device_drain_env_occupancy",
     # device health family (ISSUE 2)

@@ -288,6 +288,10 @@ def test_dashboard_page_renders(campaign_manager):
     # sparkline panels with real polylines (>=2 samples were taken)
     assert "<svg" in page and "<polyline" in page
     assert "signal growth" in page and "exec rate /s" in page
+    # admission-rate sparkline panel + yield-per-exec stat (ISSUE 5)
+    assert "admission rate /s" in page
+    assert "admission &amp; yield" in page
+    assert "execs_per_new_input" in page
     # attribution tables
     assert "per-operator yield" in page and "per-phase yield" in page
     for op in ("splice", "insert", "value"):
@@ -328,6 +332,10 @@ def test_metric_namespace_is_coherent():
     # the arena + drain families (ISSUE 3) are registered and documented
     assert {"arena_occupancy", "arena_evictions_total",
             "arena_resident_bytes", "device_drain_env_occupancy"} <= names
+    # the admission + weighted-scheduling family (ISSUE 5)
+    assert {"candidates_deduped_total", "candidates_admitted_total",
+            "admission_bloom_occupancy",
+            "arena_weighted_evictions_total"} <= names
     assert check() == []
 
 

@@ -105,6 +105,118 @@ def test_arena_gauges(env):
     assert empty.sample_indices(np.random.default_rng(0), 4) is None
 
 
+def test_weighted_eviction_protects_credited_rows(env):
+    """ISSUE 5: eviction prefers the lowest-yield row over FIFO — a
+    credited seed survives a full ring while the uncredited one of the
+    same age is overwritten, and the divergence is counted."""
+    target, tables, fmt = env
+    rows = _encode_rows(target, tables, fmt, 6)
+    reg = Registry()
+    arena = CorpusArena(4, fmt, registry=reg)
+    for cid, sval, data in rows[:4]:
+        arena.append(cid, sval, data)
+    arena.credit(0, 10.0)  # the OLDEST row earns yield
+    arena.append(*rows[4])
+    # FIFO would have evicted row 0; weighted eviction spares it and
+    # takes the lowest-yield oldest survivor (row 1) instead
+    a_cid, _, _ = (np.asarray(x) for x in arena.tensors())
+    np.testing.assert_array_equal(a_cid[0], rows[0][0])
+    np.testing.assert_array_equal(a_cid[1], rows[4][0])
+    assert arena.evictions == 1
+    assert arena.weighted_evictions == 1
+    assert reg.snapshot()["arena_weighted_evictions_total"] == 1
+    # the credited row keeps being protected: the next eviction takes
+    # the oldest zero-yield row (row 2) and still counts as a weighted
+    # divergence because FIFO would again have picked row 0 (pure-FIFO
+    # degradation with NO credit anywhere is pinned by
+    # test_ring_eviction_bounds_capacity above)
+    arena.append(*rows[5])
+    a_cid, _, _ = (np.asarray(x) for x in arena.tensors())
+    np.testing.assert_array_equal(a_cid[2], rows[5][0])
+    assert arena.evictions == 2
+    assert arena.weighted_evictions == 2
+
+
+def test_weighted_sampling_prefers_credited_rows(env):
+    """sample_indices draws from the cumulative-weight table: a heavily
+    credited row dominates the draw, and the host weight mirror matches
+    the device weight tensor bit-for-bit."""
+    target, tables, fmt = env
+    arena = CorpusArena(8, fmt, registry=Registry())
+    for cid, sval, data in _encode_rows(target, tables, fmt, 4):
+        arena.append(cid, sval, data)
+    arena.credit(3, 1000.0)
+    w = arena.host_weights()
+    np.testing.assert_array_equal(w, np.asarray(arena.weights_tensor()))
+    np.testing.assert_array_equal(w[:4], [1, 1, 1, 1001])
+    assert (w[4:] == 0).all()  # dead rows can never be drawn
+    idx = arena.sample_indices(np.random.default_rng(1), 400)
+    assert idx is not None and idx.min() >= 0 and idx.max() < 4
+    assert (idx == 3).mean() > 0.9
+    # credit on a dead/out-of-range row is ignored, not an error
+    arena.credit(7, 5.0)
+    arena.credit(-1, 5.0)
+    arena.credit(99, 5.0)
+    np.testing.assert_array_equal(arena.host_weights(), w)
+
+
+def test_credit_stamp_guards_eviction_races(env):
+    """A credit carrying the age stamp of a row that was evicted and
+    rewritten since the sample is DROPPED — yield earned by a dead seed
+    never inflates the unrelated program now living in its slot."""
+    target, tables, fmt = env
+    rows = _encode_rows(target, tables, fmt, 3)
+    arena = CorpusArena(2, fmt, registry=Registry())
+    arena.append(*rows[0])
+    arena.append(*rows[1])
+    stamp0 = int(arena.age_stamps([0])[0])
+    arena.append(*rows[2])  # evicts + rewrites row 0 (FIFO, no credit)
+    arena.credit(0, 5.0, stamp=stamp0)  # stale stamp: dropped
+    assert arena.yields[0] == 0.0
+    arena.credit(0, 5.0, stamp=int(arena.age_stamps([0])[0]))
+    assert arena.yields[0] == 5.0
+    arena.credit(1, 3.0)  # stampless credit stays accepted (host paths)
+    assert arena.yields[1] == 3.0
+
+
+def test_weight_cap_bounds_starvation(env):
+    from syzkaller_tpu.ops.arena import WEIGHT_CAP
+
+    target, tables, fmt = env
+    arena = CorpusArena(4, fmt, registry=Registry())
+    arena.append(*_encode_rows(target, tables, fmt, 1)[0])
+    arena.credit(0, 1e12)
+    assert arena.host_weights()[0] == WEIGHT_CAP + 1
+    np.testing.assert_array_equal(arena.host_weights(),
+                                  np.asarray(arena.weights_tensor()))
+
+
+def test_arena_restore_roundtrips_yield_state(env):
+    """Checkpoint/resume restores yield scores bit-identically and
+    re-projects the device weight tensor from them."""
+    target, tables, fmt = env
+    rows = _encode_rows(target, tables, fmt, 3)
+    src = CorpusArena(4, fmt, registry=Registry())
+    for cid, sval, data in rows:
+        src.append(cid, sval, data)
+    src.credit(1, 3.7)
+    dst = CorpusArena(4, fmt, registry=Registry())
+    dst.restore(*(np.asarray(x) for x in src.tensors()),
+                size=src.size, cursor=src.cursor,
+                evictions=src.evictions,
+                weighted_evictions=src.weighted_evictions,
+                yields=src.yields, ages=src.ages, seq=src._seq)
+    np.testing.assert_array_equal(dst.yields, src.yields)
+    np.testing.assert_array_equal(dst.ages, src.ages)
+    np.testing.assert_array_equal(dst.host_weights(), src.host_weights())
+    np.testing.assert_array_equal(np.asarray(dst.weights_tensor()),
+                                  np.asarray(src.weights_tensor()))
+    assert dst.weighted_evictions == src.weighted_evictions
+    # appends continue with fresh sequence stamps after the restore
+    dst.append(*rows[0])
+    assert dst.ages[dst.cursor - 1] >= src._seq
+
+
 def test_launch_path_has_no_host_stack(env, monkeypatch):
     """Guard (ISSUE 3 acceptance): the steady-state launch path is an
     O(B) device-side gather — no per-row host np.stack staging, and no

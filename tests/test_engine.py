@@ -195,6 +195,12 @@ class _FakeBatch:
     def op_mask(self, row):
         return 1
 
+    def src_row(self, row):
+        return -1  # no arena sampling provenance
+
+    def src_age(self, row):
+        return -1
+
     def call_ids(self, row):
         return [0, 1]  # prelude mmap + one live call: row executes
 

@@ -102,9 +102,11 @@ def test_sharded_fuzz_step(env):
 
 
 def test_arena_fuzz_step(env):
-    """The arena-sampling sharded step: the corpus stays resident and
-    replicated, only the [B] index vector crosses per launch, the batch
-    materializes on device via jnp.take, and the signal bitset is donated
+    """The arena-sampling sharded step: the corpus + weight table stay
+    resident and replicated, row selection is the on-device
+    yield-weighted draw (NOTHING per-row crosses per launch), the batch
+    materializes via jnp.take, admission (in-batch dedup + sharded Bloom
+    filter) gates the mutants, and the signal/Bloom bitsets are donated
     while the arena tensors are NOT (they persist across launches)."""
     target, tables, fmt, dt, m = env
     B, C = 16, fmt.max_calls
@@ -112,36 +114,103 @@ def test_arena_fuzz_step(env):
     key = jax.random.PRNGKey(11)
     cap = 8
     a_cid, a_sval, a_data = gen(key, jnp.zeros((cap,), jnp.int32))
+    repl = jax.sharding.NamedSharding(m, jax.sharding.PartitionSpec())
     a_cid, a_sval, a_data = (
-        jax.device_put(x, jax.sharding.NamedSharding(
-            m, jax.sharding.PartitionSpec()))
-        for x in (a_cid, a_sval, a_data))
+        jax.device_put(x, repl) for x in (a_cid, a_sval, a_data))
+    weights = jax.device_put(jnp.ones((cap,), jnp.uint32), repl)
 
-    step, shardings = pmesh.make_arena_fuzz_step(m, dt)
-    assert "arena" in shardings
-    idx = jnp.asarray(np.random.default_rng(3).integers(
-        0, cap, size=B), jnp.int32)
+    step, shardings = pmesh.make_arena_fuzz_step(m, dt, batch=B)
+    assert "arena" in shardings and "bloom" in shardings
     sig = jax.device_put(jnp.zeros(NBITS // 32, jnp.uint32),
                          shardings["signal"])
-    cid, sval, data, sig2, fresh, opm = step(
-        key, idx, a_cid, a_sval, a_data, sig)
+    bloom = jax.device_put(jnp.zeros(NBITS // 32, jnp.uint32),
+                           shardings["bloom"])
+    idx, cid, sval, data, sig2, bloom2, fresh, admit, opm, pop = step(
+        key, a_cid, a_sval, a_data, weights, sig, bloom)
+    assert idx.shape == (B,)
+    assert 0 <= int(jnp.min(idx)) and int(jnp.max(idx)) < cap
     assert cid.shape == (B, C)
     assert sval.shape == (B, C, dt.max_slots)
     assert opm.shape == (B,) and bool(jnp.all(opm > 0))
     assert int(jnp.sum(jax.lax.population_count(sig2))) > 0
     assert bool(jnp.any(fresh))
-    # signal donated, arena persists for the next launch
+    # admission folded every row's probes into the Bloom filter, and the
+    # reported popcount matches the updated filter
+    assert admit.shape == (B,) and bool(jnp.any(admit))
+    assert int(pop) == int(jnp.sum(jax.lax.population_count(
+        jnp.asarray(bloom2)))) > 0
+    # signal + bloom donated, arena + weights persist for the next launch
     assert sig.is_deleted()
-    assert not a_cid.is_deleted()
-    assert not a_sval.is_deleted()
-    assert not a_data.is_deleted()
+    assert bloom.is_deleted()
+    for persistent in (a_cid, a_sval, a_data, weights):
+        assert not persistent.is_deleted()
     # mutated lanes gathered from the arena still decode + validate
     batch = ProgBatch(np.asarray(cid), np.asarray(sval), np.asarray(data))
     for p in decode_batch(tables, fmt, batch):
         p.validate()
     # and the step is re-launchable against the updated signal state
-    out = step(key, idx, a_cid, a_sval, a_data, sig2)
+    out = step(key, a_cid, a_sval, a_data, weights, sig2, bloom2)
     jax.block_until_ready(out)
+
+
+def test_arena_step_outputs_replicated_over_cover(env):
+    """The batch outputs are declared replicated over the cover axis, so
+    every cover replica of a fuzz shard must hold IDENTICAL data — the
+    key is folded with the fuzz index only.  A cover-index fold would
+    make each replica draw/mutate different programs while the sharded
+    signal/Bloom folds record each replica's own phantoms (and
+    check_rep=False would silence it, replica 0 silently winning)."""
+    target, tables, fmt, dt, m = env
+    assert m.devices.shape[1] > 1, "needs a real cover axis"
+    B = 16
+    gen = pmesh.make_generate_step(m, dt, C=fmt.max_calls)
+    key = jax.random.PRNGKey(13)
+    cap = 8
+    a_cid, a_sval, a_data = gen(key, jnp.zeros((cap,), jnp.int32))
+    repl = jax.sharding.NamedSharding(m, jax.sharding.PartitionSpec())
+    a_cid, a_sval, a_data = (
+        jax.device_put(x, repl) for x in (a_cid, a_sval, a_data))
+    weights = jax.device_put(jnp.ones((cap,), jnp.uint32), repl)
+    step, shardings = pmesh.make_arena_fuzz_step(m, dt, batch=B)
+    sig = jax.device_put(jnp.zeros(NBITS // 32, jnp.uint32),
+                         shardings["signal"])
+    bloom = jax.device_put(jnp.zeros(NBITS // 32, jnp.uint32),
+                           shardings["bloom"])
+    out = step(key, a_cid, a_sval, a_data, weights, sig, bloom)
+    idx, cid, sval, data, _sig, _bloom, fresh, admit, opm, _pop = out
+    for arr in (idx, cid, fresh, admit, opm):
+        by_slice = {}
+        for sh in arr.addressable_shards:
+            by_slice.setdefault(str(sh.index), []).append(
+                np.asarray(sh.data))
+        assert by_slice and all(len(v) > 1 for v in by_slice.values()), \
+            "expected multiple cover replicas per fuzz shard"
+        for replicas in by_slice.values():
+            for r in replicas[1:]:
+                np.testing.assert_array_equal(replicas[0], r)
+
+
+def test_arena_fuzz_step_weighted_draw_concentrates(env):
+    """The on-device weighted sampler honors the weight table: with all
+    weight on one arena row, every lane draws that row."""
+    target, tables, fmt, dt, m = env
+    B = 16
+    gen = pmesh.make_generate_step(m, dt, C=fmt.max_calls)
+    key = jax.random.PRNGKey(5)
+    cap = 8
+    a_cid, a_sval, a_data = gen(key, jnp.zeros((cap,), jnp.int32))
+    repl = jax.sharding.NamedSharding(m, jax.sharding.PartitionSpec())
+    a_cid, a_sval, a_data = (
+        jax.device_put(x, repl) for x in (a_cid, a_sval, a_data))
+    w = jnp.zeros((cap,), jnp.uint32).at[3].set(7)
+    weights = jax.device_put(w, repl)
+    step, shardings = pmesh.make_arena_fuzz_step(m, dt, batch=B)
+    sig = jax.device_put(jnp.zeros(NBITS // 32, jnp.uint32),
+                         shardings["signal"])
+    bloom = jax.device_put(jnp.zeros(NBITS // 32, jnp.uint32),
+                           shardings["bloom"])
+    idx, *_ = step(key, a_cid, a_sval, a_data, weights, sig, bloom)
+    np.testing.assert_array_equal(np.asarray(idx), np.full(B, 3))
 
 
 def test_fingerprints_mask_dead_calls(env):

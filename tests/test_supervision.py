@@ -224,6 +224,12 @@ class _FakeBatch:
     def op_mask(self, row):
         return 1
 
+    def src_row(self, row):
+        return -1  # no arena sampling provenance
+
+    def src_age(self, row):
+        return -1
+
     def call_ids(self, row):
         return [0, 1]
 
@@ -606,6 +612,8 @@ def test_chaos_campaign_survives_and_resumes_bit_identical(
         f.save_checkpoint()
         want_bits = f._max_bits.copy()
         want_sig = np.asarray(f._device._sig_shard).copy()
+        want_bloom = np.asarray(f._device._bloom).copy()
+        want_yields = f._device.arena.yields.copy()
         want_arena = [np.asarray(x).copy()
                       for x in f._device.arena.tensors()]
         want_occ = (f._device.arena.size, f._device.arena.cursor)
@@ -616,6 +624,10 @@ def test_chaos_campaign_survives_and_resumes_bit_identical(
                 seed=999) as g:
         assert np.array_equal(g._max_bits, want_bits)
         assert np.array_equal(np.asarray(g._device._sig_shard), want_sig)
+        # the admission Bloom filter and the arena yield scores restore
+        # bit-identically (ISSUE 5 acceptance)
+        assert np.array_equal(np.asarray(g._device._bloom), want_bloom)
+        assert np.array_equal(g._device.arena.yields, want_yields)
         got_arena = [np.asarray(x) for x in g._device.arena.tensors()]
         for a, b in zip(got_arena, want_arena):
             assert np.array_equal(a, b)
@@ -623,12 +635,54 @@ def test_chaos_campaign_survives_and_resumes_bit_identical(
         g.loop(iterations=10)  # resumed campaign keeps fuzzing
 
 
+def test_checkpoint_restores_inflight_device_batch(tmp_path, target):
+    """The double-buffered pipeline always has one launched-but-not-yet-
+    consumed batch in flight; the checkpoint must carry it so resume
+    continues with the EXACT staged candidates instead of re-mutating a
+    batch of work (closes the PR 4 ROADMAP open item)."""
+    pytest.importorskip("jax")
+    np = pytest.importorskip("numpy")
+
+    cfg = dict(mock=True, use_device=True, device_batch=8,
+               device_period=2, smash_mutations=1, program_length=8,
+               workdir=str(tmp_path), checkpoint_interval=0)
+    with mk(target, **cfg) as f:
+        for _ in range(300):
+            f.step()
+            if f._device is not None and f._device._pending is not None:
+                break
+        assert f._device._pending is not None, "no batch ever in flight"
+        f.save_checkpoint()
+        want = [np.asarray(x).copy() for x in f._device._pending]
+    with mk(target, resume=True, **cfg) as g:
+        assert g._device._pending is not None, \
+            "in-flight batch discarded on resume"
+        got = [np.asarray(x) for x in g._device._pending]
+        assert len(got) == len(want)
+        for a, b in zip(got, want):
+            assert np.array_equal(a, b), "staged batch diverged on resume"
+        # and the resumed pipeline consumes it as a normal batch
+        before = g.stats["device_batches"]
+        for _ in range(400):
+            g.step()
+            if g.stats["device_batches"] > before or \
+                    g.stats.get("device_dropped_stale", 0) > 0 or \
+                    g.stats.get("device_deduped", 0) > 0:
+                break
+        assert (g.stats["device_batches"] > before
+                or g.stats.get("device_dropped_stale", 0) > 0
+                or g.stats.get("device_deduped", 0) > 0), \
+            "restored in-flight batch was never consumed"
+
+
 @pytest.mark.chaos
 @pytest.mark.slow
 def test_soak_kill_resume_cycles_under_random_faults(tmp_path, target):
     """Long-soak variant (excluded from tier-1): repeated kill/resume
     cycles under a random-rate FaultPlan — signal state must be
-    monotone across every restart and the engine must never crash."""
+    monotone across every restart, the in-flight device batch must
+    survive each kill bit-identically (batch continuity: resume never
+    re-mutates staged work), and the engine must never crash."""
     pytest.importorskip("jax")
     np = pytest.importorskip("numpy")
 
@@ -638,6 +692,8 @@ def test_soak_kill_resume_cycles_under_random_faults(tmp_path, target):
                env_base_backoff=0.002, env_max_backoff=0.01,
                env_probe_interval=0.01)
     prev_bits = None
+    prev_pending = None
+    pending_checked = 0
     for cycle in range(5):
         faults.install(FaultPlan(seed=cycle, rates={
             "env.exec:0": 0.02, "env.exec:1": 0.02, "env.exec:2": 0.02,
@@ -647,13 +703,27 @@ def test_soak_kill_resume_cycles_under_random_faults(tmp_path, target):
             if prev_bits is not None:
                 assert np.array_equal(f._max_bits, prev_bits), \
                     f"cycle {cycle}: resumed bitset diverged"
+            if prev_pending is not None and f._device is not None:
+                assert f._device._pending is not None, \
+                    f"cycle {cycle}: in-flight batch lost on resume"
+                for a, b in zip(f._device._pending, prev_pending):
+                    assert np.array_equal(np.asarray(a), b), \
+                        f"cycle {cycle}: in-flight batch re-mutated"
+                pending_checked += 1
             f.loop(iterations=120)
             f.poll_manager()
             f.save_checkpoint()
             prev_bits = f._max_bits.copy()
+            prev_pending = None
+            if f._device is not None and not f._device.degraded and \
+                    f._device._pending is not None:
+                prev_pending = [np.asarray(x).copy()
+                                for x in f._device._pending]
             popcount = int(sum(int(x).bit_count() for x in prev_bits))
         faults.clear()
     assert popcount > 0, "soak never accumulated signal"
+    assert pending_checked > 0, \
+        "soak never exercised in-flight batch continuity"
 
 
 # --------------------------------------------------------------------- #

@@ -439,8 +439,19 @@ def test_bench_json_line_schema(monkeypatch, capsys):
     monkeypatch.setattr(bench, "bench_host_mutate", lambda target: 10.0)
     monkeypatch.setattr(bench, "bench_cover_merge", lambda: (20.0, 2.0))
     monkeypatch.setattr(bench, "bench_hints", lambda: (30.0, 3.0))
+    # e2e-style configs return (rate, execs, new_inputs) per side so the
+    # JSON line can report execs-per-new-input (yield efficiency)
     monkeypatch.setattr(bench, "bench_e2e",
-                        lambda target: (40.0, 4.0, "mock"))
+                        lambda target: ((40.0, 400, 4), (4.0, 40, 2),
+                                        "mock"))
+    monkeypatch.setattr(
+        bench, "bench_arena_sweep",
+        lambda target: {str(c): {"execs_per_sec": 1.0, "new_inputs": 1,
+                                 "execs_per_new_input": 1.0,
+                                 "arena_occupancy": 0.5,
+                                 "arena_evictions_total": 0,
+                                 "arena_weighted_evictions_total": 0}
+                        for c in bench.ARENA_SWEEP_CAPACITIES})
     monkeypatch.setattr(bench, "bench_hub", lambda: 50.0)
 
     bench.main([])
@@ -450,8 +461,14 @@ def test_bench_json_line_schema(monkeypatch, capsys):
     assert {"metric", "value", "unit", "vs_baseline", "device",
             "configs", "telemetry"} <= set(doc)
     assert doc["vs_baseline"] == pytest.approx(100.0)
+    e2e = doc["configs"]["e2e_triage"]
+    assert e2e["execs_per_new_input"] == {"device": 100.0, "host": 20.0}
+    assert e2e["new_inputs"] == {"device": 4, "host": 2}
+    sweep = doc["configs"]["arena_sweep"]
+    for cap in bench.ARENA_SWEEP_CAPACITIES:
+        assert "execs_per_new_input" in sweep[str(cap)]
     for name in ("mutate", "cover_merge_10k", "hints_100k",
-                 "e2e_triage", "hub_sync"):
+                 "e2e_triage", "arena_sweep", "hub_sync"):
         cfg = doc["configs"][name]
         assert "error" not in cfg
         spans = cfg["spans"]
