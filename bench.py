@@ -18,6 +18,11 @@ Configs (BASELINE.json "configs"):
   arena_sweep     — the e2e loop at arena capacities {256, 1024, 4096}:
                     arena occupancy / evictions vs corpus yield per
                     capacity (the ROADMAP arena_capacity-tuning item)
+  prefix_depth_sweep — the e2e device loop over seed-program length
+                    (the shared-prefix depth axis) x prefix scheduling
+                    {off, on} at EQUAL env count: executed calls per
+                    batch/exec, prefix hit rate, and the off->on call
+                    reduction (the prefix-memoized execution claim)
 
 The e2e-style configs report execs-per-new-input (yield efficiency)
 next to execs/sec: admission/scheduling wins show up as fewer wasted
@@ -235,22 +240,50 @@ def bench_cover_merge(n_traces=10_000, pcs=64, nbits=1 << 22):
 E2E_DEVICE_PROCS = 4  # executor envs the device-pipeline drain fans over
 
 
-def _timed_loop(f, seconds: float):
+def _timed_loop(f, seconds: float, reg=None, warmup: int = 30):
     """Run a warmed Fuzzer for `seconds`; returns (execs/sec, execs,
-    new_inputs) so callers can report execs-per-new-input (yield
-    efficiency) next to the raw rate."""
-    f.loop(iterations=30)  # warm up (compiles, first corpus entries)
+    new_inputs, registry delta of the timed window) so callers can
+    report execs-per-new-input and executed-call efficiency next to
+    the raw rate.  The delta is {} without a registry."""
+    f.loop(iterations=warmup)  # warm up (compiles, first corpus entries)
+    before = reg.snapshot() if reg is not None else None
     n0 = f.stats["exec_total"]
     ni0 = f.stats["new_inputs"]
     t0 = time.perf_counter()
     f.loop(duration=seconds)
     dt = time.perf_counter() - t0
     execs = f.stats["exec_total"] - n0
-    return execs / dt, execs, f.stats["new_inputs"] - ni0
+    delta = reg.delta(before) if reg is not None else {}
+    return execs / dt, execs, f.stats["new_inputs"] - ni0, delta
+
+
+def _exec_efficiency(delta, execs, batches=0):
+    """Executed-call efficiency of one timed window from a registry
+    delta: calls-per-exec (the prefix-memoization win surface) and the
+    prefix cache hit rate.  getattr/.get-tolerant by design — engines
+    predating calls_executed_total / prefix_* (the PR6-pre harness
+    runs) report None here, so the SAME harness runs pre+post."""
+    calls = delta.get("calls_executed_total", 0)
+    hits = delta.get("prefix_cache_hits_total", 0)
+    misses = delta.get("prefix_cache_misses_total", 0)
+    out = {
+        "calls_executed_per_exec": (round(calls / max(execs, 1), 2)
+                                    if calls else None),
+        "prefix_hit_rate": (round(hits / max(hits + misses, 1), 3)
+                            if (hits or misses) else None),
+        "prefix_calls_saved": delta.get("prefix_calls_saved_total", 0),
+    }
+    if batches:
+        out["calls_per_batch"] = (round(calls / batches, 1)
+                                  if calls else None)
+    return out
 
 
 def bench_e2e(target, seconds=18.0):
     from syzkaller_tpu.engine.fuzzer import Fuzzer, FuzzerConfig
+    from syzkaller_tpu.telemetry import get_registry
+
+    reg = get_registry()
 
     def run(use_device: bool, mock: bool):
         # the device pipeline drains batches across an executor fleet
@@ -261,7 +294,8 @@ def bench_e2e(target, seconds=18.0):
             program_length=16, device_period=2, smash_mutations=4,
             procs=E2E_DEVICE_PROCS if use_device else 1)
         with Fuzzer(target, cfg) as f:
-            return _timed_loop(f, seconds)
+            rate, execs, ni, delta = _timed_loop(f, seconds, reg)
+            return rate, execs, ni, _exec_efficiency(delta, execs)
 
     cwd = os.getcwd()
     work = tempfile.mkdtemp(prefix="syztpu-bench-")
@@ -301,12 +335,18 @@ def bench_arena_sweep(target, seconds=6.0):
             program_length=16, device_period=2, smash_mutations=4,
             procs=E2E_DEVICE_PROCS, arena_capacity=cap)
         with Fuzzer(target, cfg) as f:
-            rate, execs, new_inputs = _timed_loop(f, seconds)
+            from syzkaller_tpu.telemetry import get_registry
+
+            rate, execs, new_inputs, delta = _timed_loop(
+                f, seconds, get_registry())
             arena = f._device.arena if f._device is not None else None
+            eff = _exec_efficiency(delta, execs)
             out[str(cap)] = {
                 "execs_per_sec": round(rate, 1),
                 "new_inputs": new_inputs,
                 "execs_per_new_input": round(execs / max(new_inputs, 1), 1),
+                "calls_executed_per_exec": eff["calls_executed_per_exec"],
+                "prefix_hit_rate": eff["prefix_hit_rate"],
                 "arena_occupancy": (round(arena.size / arena.capacity, 4)
                                     if arena is not None else None),
                 "arena_evictions_total": (arena.evictions
@@ -315,6 +355,69 @@ def bench_arena_sweep(target, seconds=6.0):
                     getattr(arena, "weighted_evictions", 0)
                     if arena is not None else None),
             }
+    return out
+
+
+# ------------------------------------------------------------------ #
+# config[6]: prefix-memoized execution sweep (the PR6 claim surface)
+
+PREFIX_SWEEP_LENGTHS = (4, 8, 16)
+
+
+def bench_prefix_sweep(target, seconds=8.0):
+    """The e2e device loop seeded with programs of each length (the
+    shared-prefix depth axis — splice/insert/value mutants of longer
+    seeds share deeper call prefixes) x prefix scheduling {off, on} at
+    EQUAL env count, hermetic MockEnv fleet (the sweep compares the
+    scheduler against itself, not executor speed).  device_batch=512:
+    bigger batches mean more mutants per arena seed, so groups are
+    deeper and warm-ups amortize further — the design point the
+    memoization targets.  Reports executed calls per batch/exec, the
+    prefix cache hit rate, and the off->on call reduction.  Config
+    construction and counter reads are tolerance-guarded so the SAME
+    harness runs pre+post: a pre-PR engine has no prefix_schedule knob
+    (the "on" cell is null) and no calls_executed_total (efficiency
+    cells are null)."""
+    import dataclasses
+
+    from syzkaller_tpu.engine.fuzzer import Fuzzer, FuzzerConfig
+    from syzkaller_tpu.prog.generation import generate
+    from syzkaller_tpu.telemetry import get_registry
+
+    reg = get_registry()
+    has_prefix = "prefix_schedule" in {
+        fld.name for fld in dataclasses.fields(FuzzerConfig)}
+    out = {}
+    for length in PREFIX_SWEEP_LENGTHS:
+        row = {}
+        for mode in ("off", "on"):
+            if mode == "on" and not has_prefix:
+                row[mode] = None  # pre harness: nothing to switch on
+                continue
+            kw = {"prefix_schedule": mode == "on"} if has_prefix else {}
+            cfg = FuzzerConfig(
+                mock=True, use_device=True, device_batch=512,
+                program_length=length, device_period=1,
+                smash_mutations=0, generate_period=1 << 30,
+                procs=E2E_DEVICE_PROCS, **kw)
+            with Fuzzer(target, cfg) as f:
+                # controlled corpus: the depth axis must come from the
+                # seeds, not from what triage minimized a run into
+                for i in range(32):
+                    f._add_corpus(generate(target, 1000 + i, length), ())
+                rate, execs, _ni, delta = _timed_loop(
+                    f, seconds, reg, warmup=10)
+                batches = delta.get("device_batches_total", 0)
+                eff = _exec_efficiency(delta, execs, batches=batches)
+                row[mode] = {"execs_per_sec": round(rate, 1),
+                             "batches": batches, **eff}
+        off, on = row.get("off"), row.get("on")
+        if off and on and off.get("calls_executed_per_exec") and \
+                on.get("calls_executed_per_exec"):
+            row["calls_reduction"] = round(
+                1 - on["calls_executed_per_exec"] /
+                off["calls_executed_per_exec"], 3)
+        out[f"len{length}"] = row
     return out
 
 
@@ -463,8 +566,8 @@ def main(argv=None):
 
     def _e2e():
         dev, host, executor = bench_e2e(target)
-        (dev_rate, dev_execs, dev_ni) = dev
-        (host_rate, host_execs, host_ni) = host
+        (dev_rate, dev_execs, dev_ni, dev_eff) = dev
+        (host_rate, host_execs, host_ni, host_eff) = host
         return {"device_pipeline": round(dev_rate, 1),
                 "host_only": round(host_rate, 1),
                 "unit": "execs/sec", "executor": executor,
@@ -474,7 +577,10 @@ def main(argv=None):
                 "new_inputs": {"device": dev_ni, "host": host_ni},
                 "execs_per_new_input": {
                     "device": round(dev_execs / max(dev_ni, 1), 1),
-                    "host": round(host_execs / max(host_ni, 1), 1)}}
+                    "host": round(host_execs / max(host_ni, 1), 1)},
+                # executed-call efficiency (prefix memoization): nulls
+                # when the engine predates the counters (pre harness)
+                "efficiency": {"device": dev_eff, "host": host_eff}}
 
     run_config("e2e_triage", _e2e)
 
@@ -484,6 +590,13 @@ def main(argv=None):
         return res
 
     run_config("arena_sweep", _arena_sweep)
+
+    def _prefix_sweep():
+        res = bench_prefix_sweep(target)
+        res["unit"] = "per-depth calls/exec, prefix off vs on"
+        return res
+
+    run_config("prefix_depth_sweep", _prefix_sweep)
 
     run_config("hub_sync", lambda: {
         "host": round(bench_hub(), 1), "unit": "progs/sec"})

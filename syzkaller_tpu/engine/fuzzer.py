@@ -23,7 +23,7 @@ import os
 import threading
 import time
 import weakref
-from collections import deque
+from collections import OrderedDict, deque
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Set
 
@@ -114,6 +114,17 @@ class FuzzerConfig:
     env_probe_interval: float = 1.0     # quarantined-env probe cadence (s)
     env_watchdog_seconds: float = 0.0   # per-exec watchdog deadline (0=off)
     drain_max_attempts: int = 3         # per-row attempts across envs
+    # ---- prefix-memoized batch execution (ops/prefix.py + ipc) ----
+    # build a prefix tree over each staged batch and schedule one prefix
+    # job per tree node + per-program suffix jobs, env-affine by group
+    prefix_schedule: bool = True
+    prefix_min_group: int = 2           # min users to pay for a node
+    prefix_min_calls: int = 1           # min shared ACTIVE calls memoized
+    prefix_cache_entries: int = 1024    # per-env continuation LRU bound
+    # arena yield age-decay (geometric), applied on the existing
+    # occupancy-triggered admission-Bloom reset so early-campaign
+    # jackpot rows stop pinning the weighted sampler forever
+    arena_yield_decay: float = 0.5
 
 
 class ManagerConn:
@@ -216,6 +227,32 @@ class Fuzzer:
             "drain_rows_dropped_total",
             help="device-batch rows dropped after exhausting drain "
                  "retries across envs")
+        # prefix-memoized batch execution: hit = a grouped row whose
+        # memoized prefix was reused (continuation splice on a
+        # fork-capable env, or triage-signal reuse on the fallback
+        # path); miss = a grouped row that had to pay the full prefix
+        self._m_prefix_hits = reg.counter(
+            "prefix_cache_hits_total",
+            help="grouped drain rows that reused a memoized prefix "
+                 "(continuation splice or fallback triage-signal reuse)")
+        self._m_prefix_misses = reg.counter(
+            "prefix_cache_misses_total",
+            help="grouped drain rows executed without a usable "
+                 "memoized prefix (cold cache, re-planned group, or "
+                 "first member of a group on a fallback env)")
+        # cache-warmer executions are counted HERE, not in exec_total:
+        # a prefix job completes no program, and folding it into the
+        # exec counters would bias every off-vs-on bench comparison
+        self._m_prefix_jobs = reg.counter(
+            "prefix_jobs_total",
+            help="prefix cache-warmer executions scheduled by the "
+                 "drain (not counted in exec_total — they complete no "
+                 "program)")
+        # engine-side memo of which prefix hashes have had their signal
+        # scanned for novelty once (bounded LRU-set; guards the triage
+        # scan skip for both the continuation and the fallback path)
+        self._prefix_scanned: "OrderedDict[int, bool]" = OrderedDict()
+        self._prefix_scanned_lock = threading.Lock()
         self._last_ckpt_time = 0.0
         # fuzzer_-prefixed: the manager owns the bare corpus_size gauge,
         # and in-process deployments share one registry.  Weakref-bound
@@ -260,7 +297,9 @@ class Fuzzer:
         self.envs: List = []
         for pid in range(self.cfg.procs):
             if self.cfg.mock:
-                self.envs.append(MockEnv(target, pid=pid))
+                self.envs.append(MockEnv(
+                    target, pid=pid,
+                    prefix_cache_entries=self.cfg.prefix_cache_entries))
             else:
                 ec = self.cfg.env_config or EnvConfig(sandbox=self.cfg.sandbox)
                 self.envs.append(Env(target, pid=pid, config=ec))
@@ -680,22 +719,109 @@ class Fuzzer:
                 thread_name_prefix="syztpu-drain")
         return self._drain_pool
 
-    def _run_device_batch_inner(self, batch) -> None:
-        """Drain one device batch across ALL executor envs: one worker per
-        env pulls rows off a shared pending deque (dynamic balancing — a
-        row that skips costs ~nothing, a row that executes costs an exec
-        round trip), so per-env serialization is preserved by construction
-        while the fleet drains in parallel.
+    def _plan_prefixes(self, batch):
+        """Build the prefix-tree execution schedule for one staged batch
+        (ops/prefix.build_plan under a ``device.prefix_plan`` span).
+        getattr-tolerant by design: batches without encoded tensors
+        (host-fallback paths, test fakes) or with prefix scheduling off
+        plan nothing and drain exactly like before."""
+        if not self.cfg.prefix_schedule:
+            return None
+        enc = getattr(batch, "batch", None)
+        if enc is None or len(batch) < 2:
+            return None
+        from ..ops import prefix as pfx
 
-        The fan-out is SUPERVISED (engine/supervisor.py): an exec failure
-        records against the env (jittered-backoff restart, quarantine
-        past the threshold) and the row goes back on the deque so a
-        surviving env re-executes it — rows are executed exactly once on
-        success, and only dropped (counted) after ``drain_max_attempts``
-        distinct attempts.  A worker whose env is quarantined leaves the
-        remaining rows to the survivors when any exist; otherwise it
-        waits out the backoff and relies on un-quarantine probes, so a
-        fully-failed fleet still makes progress once envs recover.
+        with span("device.prefix_plan"):
+            # only rows with emitted exec streams can continue; the
+            # decode-fallback long tail drains ungrouped
+            rows = [r for r in range(len(batch))
+                    if batch.streams[r] is not None]
+            try:
+                plan = pfx.build_plan(
+                    enc.call_id, enc.slot_val, enc.data, rows=rows,
+                    min_group=self.cfg.prefix_min_group,
+                    min_calls=self.cfg.prefix_min_calls)
+            except Exception as e:
+                count_error("prefix_plan", e)
+                return None
+        if not plan:
+            return None
+        # cost/benefit gate: on a continuation fleet the prefix jobs
+        # cost real executor round trips, so a plan whose estimated
+        # splice savings don't exceed that warm-up cost is worse than
+        # no plan.  Fallback fleets never pay warm-ups (the grouping
+        # only feeds the free triage-scan reuse), so they keep it.
+        if plan.calls_saved_est <= 0 and any(
+                getattr(e, "supports_continuation", False)
+                for e in self.envs):
+            return None
+        return plan
+
+    def _assign_prefix_jobs(self, plan, env_jobs, overflow,
+                            workers) -> None:
+        """Partition the plan's root subtrees across drain workers —
+        env-AFFINE: every prefix job and suffix row of one tree lands on
+        the env that will hold its continuation cache entries.
+        Quarantined envs are passed over at assignment time.
+
+        When the chosen env has no continuation support (the real
+        executor today), there is no per-env cache to be affine TO —
+        the memoized-signal triage reuse keys off the engine-global
+        scanned-set — so its grouped rows go to the shared overflow
+        deque instead: pinning them would serialize the drain (measured
+        +30% per-batch drain time on the 4-env real fleet) for zero
+        cache benefit, and no cache-warming round trip is ever paid."""
+        children: Dict[int, List[int]] = {}
+        roots: List[int] = []
+        for nid, nd in enumerate(plan.nodes):
+            if nd.parent < 0:
+                roots.append(nid)
+            else:
+                children.setdefault(nd.parent, []).append(nid)
+        healthy = set(self.supervisor.healthy_envs())
+        cand = [k for k in workers if k in healthy] or list(workers)
+        load = {k: 0 for k in cand}
+        for root in roots:
+            subtree = []
+            stack = [root]
+            while stack:
+                nid = stack.pop()
+                subtree.append(nid)
+                stack.extend(children.get(nid, ()))
+            k = min(cand, key=lambda q: (load[q], q))
+            cont = getattr(self.envs[k], "supports_continuation", False)
+            for nid in sorted(subtree):  # plan order: parents first
+                if cont:
+                    env_jobs[k].append(("prefix", nid))
+                    load[k] += 1
+                for r in plan.nodes[nid].rows:
+                    if cont:
+                        env_jobs[k].append(("row", r, nid, 0))
+                        load[k] += 1
+                    else:
+                        overflow.append(("row", r, nid, 0))
+
+    def _run_device_batch_inner(self, batch) -> None:
+        """Drain one device batch across ALL executor envs under the
+        prefix-tree schedule: grouped rows are env-affine (all children
+        of a tree node drain to the env holding its continuation cache
+        entry), ungrouped rows load-balance dynamically off a shared
+        overflow deque, and idle workers steal row jobs from the longest
+        peer queue (a stolen suffix row self-heals its memo on the new
+        env at the cost of one full exec).
+
+        The fan-out stays SUPERVISED (engine/supervisor.py): an exec
+        failure records against the env (jittered-backoff restart,
+        quarantine past the threshold) and the row is re-planned onto a
+        surviving env via the overflow deque — rows execute exactly once
+        on success and are only dropped (counted AND surfaced in the
+        wire stats) after ``drain_max_attempts`` distinct attempts.
+        When a worker's env is quarantined, its remaining ROW jobs are
+        re-planned to the survivors; its prefix jobs are dropped (they
+        are cache warmers for that env only — suffix rows self-heal).
+        The LAST worker never leaves: it waits out backoff and relies on
+        un-quarantine probes, so a fully-failed fleet still drains.
 
         Stat/ledger updates go through the locked ``_record_exec``
         helper; triage enqueue and corpus adds are already thread-safe;
@@ -703,11 +829,58 @@ class Fuzzer:
         thread, after the workers join."""
         n = len(batch)
         nworkers = max(min(len(self.envs), n), 1)
-        pending = deque((row, 0) for row in range(n))
+        plan = self._plan_prefixes(batch)
+        overflow: deque = deque()  # ungrouped + re-planned row jobs
+        env_jobs: List[deque] = [deque() for _ in range(nworkers)]
+        grouped: Set[int] = set()
+        if plan is not None:
+            self._assign_prefix_jobs(plan, env_jobs, overflow,
+                                     range(nworkers))
+            grouped = set(plan.row_node)
+        for row in range(n):
+            if row not in grouped:
+                overflow.append(("row", row, -1, 0))
         rows_lock = threading.Lock()
         active = [nworkers]  # workers still in their loop (rows_lock)
         sup = self.supervisor
         max_attempts = max(self.cfg.drain_max_attempts, 1)
+
+        def stealable() -> bool:
+            return any(job[0] == "row" for q in env_jobs for job in q)
+
+        def take_job(env_idx: int):
+            """rows_lock held: own affine queue first, then the shared
+            overflow, then steal a ROW job from the tail of the longest
+            peer queue that HAS one (prefix jobs are useless off their
+            env — a queue of only warmers is no victim)."""
+            if env_jobs[env_idx]:
+                return env_jobs[env_idx].popleft()
+            if overflow:
+                return overflow.popleft()
+            victim = max(
+                (q for q in env_jobs
+                 if any(j[0] == "row" for j in q)),
+                key=len, default=None)
+            if victim is None:
+                return None
+            skipped = []
+            stolen = None
+            while victim:
+                item = victim.pop()
+                if item[0] == "row":
+                    stolen = item
+                    break
+                skipped.append(item)
+            victim.extend(reversed(skipped))
+            return stolen
+
+        def dump_queue(env_idx: int) -> None:
+            """rows_lock held: re-plan this env's remaining row jobs to
+            the survivors; drop its prefix jobs (cache warmers)."""
+            for job in env_jobs[env_idx]:
+                if job[0] == "row":
+                    overflow.append(job)
+            env_jobs[env_idx].clear()
 
         def drain(env_idx: int):
             sigs: List[List[int]] = []
@@ -717,29 +890,54 @@ class Fuzzer:
                 while True:
                     item = None
                     with rows_lock:
-                        if not pending:
+                        if not (env_jobs[env_idx] or overflow
+                                or stealable()):
                             active[0] -= 1
                             left = True
                             return sigs, done
+                        # acquire exactly once per iteration: it has
+                        # side effects (probe grants, backoff reads)
                         if sup.acquire(env_idx):
-                            item = pending.popleft()
+                            item = take_job(env_idx)
+                        elif not (overflow or stealable()) and \
+                                all(j[0] == "prefix"
+                                    for j in env_jobs[env_idx]):
+                            # only droppable cache warmers remain and
+                            # this env can't take one right now: drop
+                            # them and leave — the last worker must
+                            # never stall a whole batch drain waiting
+                            # out backoff for jobs whose loss is free
+                            env_jobs[env_idx].clear()
+                            active[0] -= 1
+                            left = True
+                            return sigs, done
                         elif active[0] > 1 and \
                                 sup.usable_elsewhere(env_idx):
-                            # hand remaining rows to the survivors; the
+                            # hand remaining work to the survivors; the
                             # check and the worker-count decrement are
                             # atomic so the LAST worker can never leave
                             # (it waits out backoff and relies on
                             # un-quarantine probes — otherwise two dying
                             # workers could each trust the other and
                             # strand the rows)
+                            dump_queue(env_idx)
                             active[0] -= 1
                             left = True
                             return sigs, done
                     if item is None:
                         time.sleep(0.005)
                         continue
-                    row, attempts = item
-                    status, sig = self._drain_row(batch, row, env_idx)
+                    if item[0] == "prefix":
+                        sig = self._drain_prefix(batch, plan, item[1],
+                                                 env_idx)
+                        done += 1
+                        if sig:
+                            sigs.append(sig)
+                        continue
+                    _, row, nid, attempts = item
+                    node = plan.nodes[nid] if nid >= 0 else None
+                    status, sig = self._drain_row(batch, row, env_idx,
+                                                  node=node)
                     if status == "env_failure":
                         # charge the env only for a row's FIRST failure:
                         # a row that already failed elsewhere is evidence
@@ -750,9 +948,10 @@ class Fuzzer:
                             sup.record_failure(env_idx)
                         with rows_lock:
                             if attempts + 1 < max_attempts:
-                                pending.append((row, attempts + 1))
+                                overflow.append(
+                                    ("row", row, nid, attempts + 1))
                             else:
-                                self._m_rows_dropped.inc()
+                                self._note_dropped_row()
                         continue
                     if status == "ok":
                         sup.record_success(env_idx)
@@ -762,6 +961,7 @@ class Fuzzer:
             finally:
                 if not left:  # exception path: stop counting as active
                     with rows_lock:
+                        dump_queue(env_idx)
                         active[0] -= 1
 
         results = []
@@ -787,7 +987,157 @@ class Fuzzer:
         if first_exc is not None:
             raise first_exc
 
-    def _drain_row(self, batch, row: int, env_idx: int):
+    def _note_dropped_row(self) -> None:
+        """One drain row exhausted its retries: count it in the
+        registry, in the supervisor's introspection, AND in the wire
+        stats — /stats.json and the dashboard supervision table must
+        show silent loss, not just /metrics."""
+        self._m_rows_dropped.inc()
+        self.supervisor.record_dropped()
+        with self._stats_lock:
+            self.stats["drain_rows_dropped"] = self.stats.get(
+                "drain_rows_dropped", 0) + 1
+
+    def _prefix_seen(self, h: int) -> bool:
+        with self._prefix_scanned_lock:
+            seen = h in self._prefix_scanned
+            if seen:
+                self._prefix_scanned.move_to_end(h)
+            return seen
+
+    def _claim_prefix_scan(self, h: int) -> bool:
+        """Atomic test-and-claim of the novelty scan for a prefix hash:
+        exactly ONE concurrent drain worker gets True (it must scan the
+        prefix range and, on a failed decode, release via
+        ``_release_prefix_scan`` so a sibling can rescue the group's
+        coverage).  A separate check-then-mark would let two siblings
+        both take the scan path and enqueue duplicate TriageItems."""
+        with self._prefix_scanned_lock:
+            if h in self._prefix_scanned:
+                self._prefix_scanned.move_to_end(h)
+                return False
+            self._prefix_scanned[h] = True
+            while len(self._prefix_scanned) > 4096:
+                self._prefix_scanned.popitem(last=False)
+            return True
+
+    def _release_prefix_scan(self, h: int) -> None:
+        with self._prefix_scanned_lock:
+            self._prefix_scanned.pop(h, None)
+
+    def _count_prefix_reuse(self, hit: bool) -> None:
+        """Registry + wire-stat accounting for one grouped row: ``hit``
+        when its memoized prefix was reused (continuation splice or
+        fallback triage-signal reuse)."""
+        (self._m_prefix_hits if hit else self._m_prefix_misses).inc()
+        key = "prefix_hits" if hit else "prefix_misses"
+        with self._stats_lock:
+            self.stats[key] = self.stats.get(key, 0) + 1
+
+    def _scan_infos_for_triage(self, batch, row: int, infos, origin,
+                               skip_prefix_calls: int = 0) -> bool:
+        """Novelty-scan one execution's CallInfos and enqueue triage
+        work.  ``skip_prefix_calls`` > 0 skips call indices
+        1..skip_prefix_calls — the memoized-prefix reuse: that range was
+        scanned once when the prefix hash first executed, so the
+        new-signal test never re-parses known prefix coverage (the
+        prelude mmap at index 0 is always scanned: it runs fresh).
+
+        Returns False when novel signal was found but the row failed to
+        decode (the codec long tail) — the triage work was LOST, so the
+        caller must NOT mark the prefix hash as scanned: a sibling's
+        scan may still decode and rescue the group's coverage."""
+        decoded = None
+        ok = True
+        for info in infos:
+            if 1 <= info.index <= skip_prefix_calls:
+                continue
+            diff = self._signal_diff(info.signal)
+            if not diff:
+                continue
+            if decoded is None:
+                decoded = batch.decode(row)
+            if decoded is not None and info.index < len(decoded.calls):
+                self.queue.push_triage(TriageItem(
+                    prog=decoded.clone(), call_index=info.index,
+                    signal=diff, origin=origin))
+            else:
+                ok = False
+        return ok
+
+    def _drain_prefix(self, batch, plan, nid: int, env_idx: int):
+        """Execute one PREFIX JOB — the cache-warming execution of a
+        tree node's shared prefix on its affine env, continuing from the
+        parent node's memo when present.  Never retried: a failed
+        prefix job costs the group only its warm start (suffix rows
+        self-heal the memo via their full-exec fallback), so it carries
+        no exactly-once obligation.  Returns the executed signal (for
+        the mirror fold) or None."""
+        node = plan.nodes[nid]
+        stream = batch.streams[node.carrier]
+        call_ids = batch.call_ids(node.carrier)
+        if stream is None:
+            return None  # carrier fell back to decode: nothing to warm
+        env = self.envs[env_idx]
+        parent = plan.nodes[node.parent] if node.parent >= 0 else None
+        origin = Provenance(_attr.PHASE_MUTATE,
+                            ops_from_mask(batch.op_mask(node.carrier)),
+                            row=batch.src_row(node.carrier),
+                            row_age=batch.src_age(node.carrier))
+        try:
+            with self.supervisor.guard(env_idx, env):
+                res = env.exec_prefix(
+                    ExecOpts(), stream, call_ids, node.n_calls,
+                    node.hash,
+                    parent_hash=parent.hash if parent else None,
+                    parent_calls=parent.n_calls if parent else 0)
+        except Exception as e:
+            count_error("drain_exec", e)
+            self.supervisor.record_failure(env_idx)
+            return None
+        if res is None:
+            return None  # env has no fork point: nothing was executed
+        _, infos, failed, hanged, saved = res
+        if saved:
+            # wire-stat mirror of prefix_calls_saved_total: the ipc
+            # layer reports exactly what memoization skipped (parent
+            # continuation OR an already-warm cross-batch memo)
+            with self._stats_lock:
+                self.stats["prefix_calls_saved"] = self.stats.get(
+                    "prefix_calls_saved", 0) + int(saved)
+        # a warm-up completes no program: separate accounting keeps
+        # exec_total (and the ledger it feeds) comparable across
+        # scheduling modes — the carrier's own suffix exec carries the
+        # ledger credit exactly once
+        self._m_prefix_jobs.inc()
+        with self._stats_lock:
+            self.stats["prefix_jobs"] = self.stats.get(
+                "prefix_jobs", 0) + 1
+        if failed:
+            if not infos:
+                self.supervisor.record_failure(env_idx)
+            return None
+        self.supervisor.record_success(env_idx)
+        if hanged:
+            return None
+        # scan the shared prefix for novelty ONCE per group (atomic
+        # claim — a warm recurring node from an earlier batch is
+        # already covered; a failed decode releases the claim so a
+        # sibling can rescue).  A nested node's prefix CONTAINS its
+        # parent's — skip the range the parent's job already scanned,
+        # or every child level would re-enqueue duplicate TriageItems
+        # for it (max_signal only advances at triage time, so the diff
+        # would fire again)
+        if self._claim_prefix_scan(node.hash):
+            skip = (parent.n_calls if parent is not None
+                    and self._prefix_seen(parent.hash) else 0)
+            if not self._scan_infos_for_triage(
+                    batch, node.carrier, infos, origin,
+                    skip_prefix_calls=skip):
+                self._release_prefix_scan(node.hash)
+        return sorted({s for info in infos for s in info.signal})
+
+    def _drain_row(self, batch, row: int, env_idx: int, node=None):
         """Execute one batch row on env ``env_idx``; returns
         ``(status, signal)`` where status is one of
 
@@ -806,6 +1156,12 @@ class Fuzzer:
                             watchdog interrupt — failed with NO call
                             records): the caller re-shards the row onto a
                             surviving env
+
+        ``node`` (a prefix-tree PrefixNode) marks a grouped SUFFIX JOB:
+        on a continuation-capable env the row executes as
+        ``exec_suffix`` (memoized prefix spliced with a fresh suffix);
+        on a fallback env it executes fully but skips the novelty
+        re-scan of prefix calls already scanned under the node's hash.
 
         Runs on drain worker threads — only thread-safe state may be
         touched (see _run_device_batch_inner)."""
@@ -848,10 +1204,18 @@ class Fuzzer:
                 from ..utils.log import logf
                 logf(0, "executing program %d:\n%s", env_idx, serialize(p))
         env = self.envs[env_idx]
+        cont = node is not None and \
+            getattr(env, "supports_continuation", False)
+        hit: Optional[bool] = None
         try:
             with self.supervisor.guard(env_idx, env):
-                _, infos, failed, hanged = env.exec_raw(
-                    ExecOpts(), stream, call_ids)
+                if cont:
+                    _, infos, failed, hanged, hit = env.exec_suffix(
+                        ExecOpts(), stream, call_ids, node.n_calls,
+                        node.hash)
+                else:
+                    _, infos, failed, hanged = env.exec_raw(
+                        ExecOpts(), stream, call_ids)
         except Exception as e:
             count_error("drain_exec", e)
             return "env_failure", None
@@ -863,17 +1227,33 @@ class Fuzzer:
             return ("fail" if infos else "env_failure"), None
         if hanged:
             return "hang", None
-        decoded = None
-        for info in infos:
-            diff = self._signal_diff(info.signal)
-            if not diff:
-                continue
-            if decoded is None:
-                decoded = batch.decode(row)
-            if decoded is not None and info.index < len(decoded.calls):
-                self.queue.push_triage(TriageItem(
-                    prog=decoded.clone(), call_index=info.index,
-                    signal=diff, origin=origin))
+        skip = 0
+        claimed = False
+        if node is not None:
+            # the engine's scanned-set is the SINGLE authority for the
+            # novelty-scan skip — an env-side memo hit only says calls
+            # were spliced, not that their coverage was ever parsed
+            # (the carrier's scan may have failed decode, or the memo
+            # may predate this engine's scanned-set LRU window).  The
+            # claim is atomic: exactly one concurrent sibling scans.
+            claimed = self._claim_prefix_scan(node.hash)
+            # metric: a continuation splice is a hit even when this
+            # row also draws the (one) scan duty for the group
+            self._count_prefix_reuse(bool(hit) if hit is not None
+                                     else not claimed)
+            if hit:  # wire-stat mirror of prefix_calls_saved_total
+                with self._stats_lock:
+                    self.stats["prefix_calls_saved"] = \
+                        self.stats.get("prefix_calls_saved", 0) + \
+                        node.n_calls
+            if not claimed:
+                skip = node.n_calls
+        ok = self._scan_infos_for_triage(batch, row, infos, origin,
+                                         skip_prefix_calls=skip)
+        if claimed and not ok:
+            # the claimed scan failed to decode: release so a sibling
+            # (or a later batch) can rescue the group's prefix coverage
+            self._release_prefix_scan(node.hash)
         return "ok", sorted({s for info in infos for s in info.signal})
 
     # ---- the loop ----
@@ -1249,6 +1629,7 @@ class _DevicePipeline:
         self.B = -(-cfg.device_batch // self.n_fuzz) * self.n_fuzz
         self._k_probes = max(int(cfg.admission_probes), 1)
         self._bloom_decay = float(cfg.admission_bloom_decay)
+        self._yield_decay = float(cfg.arena_yield_decay)
         self._step, self._shardings = pmesh.make_arena_fuzz_step(
             self.mesh, self.dt, batch=self.B, k_probes=self._k_probes)
         # the sharded bitset mapping requires power-of-two total bits
@@ -1486,6 +1867,10 @@ class _DevicePipeline:
         if occ >= self._bloom_decay:
             self._reset_bloom()
             self._c_bloom_resets.inc()
+            # age-decay the arena yield scores on the same occupancy
+            # cadence: early-campaign jackpot rows must keep earning to
+            # keep their weighted-sampler pin (ROADMAP carried item)
+            self.arena.decay_yields(self._yield_decay)
         if keep.size < total:
             cid, sval, data = cid[keep], sval[keep], data[keep]
             op_mask, idx = op_mask[keep], idx[keep]

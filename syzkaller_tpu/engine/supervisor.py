@@ -80,6 +80,11 @@ class EnvSupervisor:
             "env_unquarantine_probes_total",
             help="probe executions granted to quarantined envs")
         self._g_quarantined.set(0)
+        # rows the drain gave up on after drain_max_attempts — the
+        # supervision-local mirror of the engine's accounting, queryable
+        # next to failures()/quarantined_count() (the operator surfaces
+        # read the registry counter and the wire stat, not this)
+        self._dropped_rows = 0
 
         # watchdog: in-flight exec deadlines, scanned by one monitor
         # thread (started lazily on the first guarded call)
@@ -143,11 +148,32 @@ class EnvSupervisor:
                 st.quarantined = False
                 self._update_quarantine_gauge_locked()
 
+    def record_dropped(self, n: int = 1) -> None:
+        """The drain exhausted a row's retries across envs: the work is
+        LOST, not just delayed.  This keeps the loss queryable from the
+        supervision state machine (tests, tooling); the operator-facing
+        surfaces are fed by the engine's drain_rows_dropped_total
+        counter and ``drain_rows_dropped`` wire stat."""
+        with self._lock:
+            self._dropped_rows += int(n)
+
     def _update_quarantine_gauge_locked(self) -> None:
         self._g_quarantined.set(
             sum(1 for st in self._envs if st.quarantined))
 
     # ---- introspection (tests, dashboard) ----
+
+    def dropped_rows(self) -> int:
+        with self._lock:
+            return self._dropped_rows
+
+    def healthy_envs(self) -> List[int]:
+        """Indices of envs currently fit for planned work (not
+        quarantined) — the drain's prefix-group assignment prefers
+        these so a whole group is never planned onto a sick env."""
+        with self._lock:
+            return [i for i, st in enumerate(self._envs)
+                    if not st.quarantined]
 
     def is_quarantined(self, env_idx: int) -> bool:
         with self._lock:

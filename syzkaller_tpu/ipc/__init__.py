@@ -21,6 +21,7 @@ import subprocess
 import tempfile
 import threading
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
@@ -49,6 +50,32 @@ def _kill_escalations_counter():
         "env_kill_escalations_total",
         help="executor shutdowns escalated to SIGKILL after the "
              "graceful quit timed out")
+
+
+def _calls_executed_counter():
+    return get_registry().counter(
+        "calls_executed_total",
+        help="syscalls actually executed across all exec paths (prefix "
+             "continuation hits skip the memoized calls, so this runs "
+             "below calls-per-program * exec_total when prefix "
+             "scheduling wins)")
+
+
+def _prefix_saved_counter():
+    return get_registry().counter(
+        "prefix_calls_saved_total",
+        help="call executions skipped by splicing a memoized prefix "
+             "(exec_suffix continuation hits + exec_prefix parent "
+             "continuations)")
+
+
+# prefix-continuation cache entries held per env (exec_prefix results
+# keyed by prefix hash); LRU-bounded so a long campaign can't grow the
+# memo without bound — an evicted entry only costs one full re-exec.
+# Sized to hold several batches' worth of tree nodes: recurring
+# arena-seed prefixes then cost ZERO warm-up in steady state (entries
+# are a handful of CallInfos each — this is kilobytes, not megabytes)
+PREFIX_CACHE_ENTRIES = 1024
 
 _REQ = struct.Struct("<6Q")
 _REPLY = struct.Struct("<3Q")
@@ -129,12 +156,30 @@ class ExecError(RuntimeError):
     pass
 
 
+def _copy_info(i: CallInfo) -> CallInfo:
+    """Fresh CallInfo with copied lists: memoized prefix infos are
+    spliced into many programs' results, and shared mutable lists across
+    results would let one consumer's edit corrupt another's."""
+    return CallInfo(index=i.index, num=i.num, errno=i.errno,
+                    executed=i.executed, fault_injected=i.fault_injected,
+                    signal=list(i.signal), cover=list(i.cover),
+                    comps=list(i.comps))
+
+
 class Env:
     """One executor process + its two shared-memory files.
 
     Lazily (re)spawns the executor like the reference (a crashed executor is
     respawned on the next exec, ipc_linux.go:128-160).
     """
+
+    # The native executor has no fork/snapshot point (protocol.py
+    # CMD_EXEC_PREFIX/SUFFIX are reserved for a fork-server executor),
+    # so prefix jobs are never scheduled here and exec_suffix falls back
+    # to a full execution; the engine still reuses the memoized prefix
+    # SIGNAL for triage (the new-signal scan skips call indices the
+    # prefix hash already covered).
+    supports_continuation = False
 
     def __init__(self, target, pid: int = 0,
                  config: Optional[EnvConfig] = None,
@@ -159,6 +204,7 @@ class Env:
         self._proc: Optional[subprocess.Popen] = None
         self.restarts = 0
         self._h_exec = _exec_histogram()
+        self._c_calls = _calls_executed_counter()
 
     # ---- process lifecycle ----
 
@@ -337,7 +383,28 @@ class Env:
                     executed=False, fault_injected=False,
                     signal=[], cover=[], comps=[]))
         infos.sort(key=lambda i: i.index)
+        self._c_calls.inc(sum(1 for i in infos if i.executed))
         return b"", infos, failed, hanged
+
+    # ---- prefix continuation (prefix-memoized batch execution) ----
+
+    def exec_prefix(self, opts: ExecOpts, data: bytes,
+                    call_ids: List[int], n_calls: int, prefix_hash: int,
+                    parent_hash: Optional[int] = None,
+                    parent_calls: int = 0):
+        """Unsupported without a fork point: returns None so the drain
+        scheduler never pays a wasted round trip warming a cache this
+        env cannot hold (see protocol.CMD_EXEC_PREFIX)."""
+        return None
+
+    def exec_suffix(self, opts: ExecOpts, data: bytes,
+                    call_ids: List[int], n_prefix: int, prefix_hash: int
+                    ) -> Tuple[bytes, List[CallInfo], bool, bool, bool]:
+        """Continuation fallback: full execution, never a memo hit (the
+        trailing bool).  The engine-side triage reuse of the memoized
+        prefix signal is what this path still benefits from."""
+        out, infos, failed, hanged = self.exec_raw(opts, data, call_ids)
+        return out, infos, failed, hanged, False
 
     def _parse_out(self) -> List[CallInfo]:
         # The out region is executor-written and the child can die mid-write;
@@ -378,14 +445,32 @@ class Env:
 class MockEnv:
     """Hermetic in-process stand-in for Env: deterministic synthetic signal
     keyed on (call id, arg fingerprint) with no subprocess. Used by unit
-    tests and the engine's dry-run mode."""
+    tests and the engine's dry-run mode.
 
-    def __init__(self, target, pid: int = 0, signal_space: int = 1 << 20):
+    Implements EXACT prefix continuation (exec_prefix/exec_suffix): the
+    synthetic per-call signal is a pure function of the call instruction
+    itself, so a memoized prefix spliced with a freshly executed suffix
+    is bit-identical to the full execution — the property tier-1 pins so
+    the scheduler's correctness contract is testable without a
+    fork-server executor."""
+
+    supports_continuation = True
+
+    def __init__(self, target, pid: int = 0, signal_space: int = 1 << 20,
+                 prefix_cache_entries: int = PREFIX_CACHE_ENTRIES):
         self.target = target
         self.pid = pid
         self.signal_space = signal_space
         self.restarts = 0
         self._h_exec = _exec_histogram()
+        self._c_calls = _calls_executed_counter()
+        self._c_saved = _prefix_saved_counter()
+        # prefix memo: (prefix_hash, opts key) -> tuple of CallInfos for
+        # call indices 1..n (the prelude mmap is never cached: its args
+        # depend on the FULL program's page budget, so each program's
+        # own execution recomputes it).  Bounded LRU.
+        self.prefix_cache_entries = max(int(prefix_cache_entries), 1)
+        self._prefix_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
 
     def close(self) -> None:
         pass
@@ -423,20 +508,37 @@ class MockEnv:
         """Synthesize deterministic signal from the decoded instruction
         stream (the one authority for both exec() and the raw path).
         Pointer-valued consts (>= data_offset) fingerprint as pointers."""
-        from ..prog.encodingexec import decode_exec
-
         if _faults.should_fire(f"env.exec:{self.pid}"):
             # injected env death: report failed like a crashed executor
             self.restarts += 1
             return b"", [], True, False
 
         t0 = time.perf_counter()
+        infos = self._synth_range(opts, data)
+        self._h_exec.observe(time.perf_counter() - t0)
+        self._c_calls.inc(len(infos))
+        return b"", infos, False, False
+
+    def _synth_range(self, opts: ExecOpts, data: bytes, lo: int = 0,
+                     hi: Optional[int] = None) -> List[CallInfo]:
+        """The one signal authority: CallInfos for the stream's call
+        instructions with index in [lo, hi) — the full stream when
+        unbounded.  Each info is a pure function of its own instruction
+        (id + args), which is what makes prefix memoization EXACT here:
+        the executed range never changes any produced info."""
+        from ..prog.encodingexec import decode_exec
+
         data_off = getattr(self.target, "data_offset", 512 << 20)
         infos: List[CallInfo] = []
         i = 0
         for ins in decode_exec(data):
             if ins["op"] != "call":
                 continue
+            if i < lo:
+                i += 1
+                continue
+            if hi is not None and i >= hi:
+                break
             cid = ins["id"]
             h = self._mix(cid * 2654435761)
             sig = [h % self.signal_space]
@@ -464,8 +566,109 @@ class MockEnv:
                 cover=sig if opts.collect_cover else [],
                 comps=comps if opts.collect_comps else []))
             i += 1
+        return infos
+
+    # ---- prefix continuation (exact: see class docstring) ----
+
+    @staticmethod
+    def _memo_key(prefix_hash: int, opts: ExecOpts) -> tuple:
+        # collection flags change the info payloads, so a memo taken
+        # under different opts must not splice
+        return (int(prefix_hash), opts.collect_signal, opts.collect_cover,
+                opts.collect_comps)
+
+    def _memo_get(self, key: tuple):
+        entry = self._prefix_cache.get(key)
+        if entry is not None:
+            self._prefix_cache.move_to_end(key)
+        return entry
+
+    def _memo_put(self, key: tuple, infos: List[CallInfo]) -> None:
+        self._prefix_cache[key] = tuple(_copy_info(x) for x in infos)
+        self._prefix_cache.move_to_end(key)
+        while len(self._prefix_cache) > self.prefix_cache_entries:
+            self._prefix_cache.popitem(last=False)
+
+    def exec_prefix(self, opts: ExecOpts, data: bytes,
+                    call_ids: List[int], n_calls: int, prefix_hash: int,
+                    parent_hash: Optional[int] = None,
+                    parent_calls: int = 0):
+        """Execute the carrier stream's first ``n_calls`` calls (call
+        indices 1..n — NOT the prelude mmap: its page budget is a
+        whole-program property, so every sibling's suffix execution
+        must re-run its own prelude regardless, and executing the
+        carrier's here would be pure warm-up waste) and memoize the
+        per-call results under ``prefix_hash``.  With a memoized
+        ``parent_hash`` (this node's tree parent), only the marginal
+        ``n_calls - parent_calls`` calls execute — the
+        nested-continuation edge of the prefix tree.  Returns
+        ``(out, infos, failed, hanged, calls_saved)`` — the trailing
+        int is how many call executions memoization skipped in THIS
+        job (truthy == some memo was reused), so the engine's wire
+        stats can mirror prefix_calls_saved_total exactly."""
+        if _faults.should_fire(f"env.exec:{self.pid}"):
+            self.restarts += 1
+            return b"", [], True, False, 0
+        t0 = time.perf_counter()
+        # already warm (the memo persists ACROSS batches and arena-seed
+        # prefixes recur batch after batch): execute nothing at all —
+        # steady-state warm-up cost for a recurring prefix is zero
+        own = self._memo_get(self._memo_key(prefix_hash, opts))
+        if own is not None and len(own) == n_calls:
+            saved = (n_calls - parent_calls if parent_hash is not None
+                     else n_calls)
+            self._c_saved.inc(saved)
+            self._h_exec.observe(time.perf_counter() - t0)
+            return (b"", [_copy_info(x) for x in own], False, False,
+                    saved)
+        parent = None
+        if parent_hash is not None and 0 < parent_calls <= n_calls:
+            parent = self._memo_get(self._memo_key(parent_hash, opts))
+            if parent is not None and len(parent) != parent_calls:
+                parent = None  # hash reuse at another depth: not ours
+        if parent is not None:
+            run = self._synth_range(opts, data, parent_calls + 1,
+                                    n_calls + 1)
+            infos = [_copy_info(x) for x in parent] + run
+            self._c_saved.inc(parent_calls)
+            saved = parent_calls
+        else:
+            infos = self._synth_range(opts, data, 1, n_calls + 1)
+            run = infos
+            saved = 0
+        self._memo_put(self._memo_key(prefix_hash, opts), infos)
         self._h_exec.observe(time.perf_counter() - t0)
-        return b"", infos, False, False
+        self._c_calls.inc(len(run))
+        return b"", infos, False, False, saved
+
+    def exec_suffix(self, opts: ExecOpts, data: bytes,
+                    call_ids: List[int], n_prefix: int, prefix_hash: int
+                    ) -> Tuple[bytes, List[CallInfo], bool, bool, bool]:
+        """Execute only the prelude + suffix of a full program stream,
+        splicing the memoized prefix CallInfos — bit-identical to the
+        full execution (tier-1 pins this).  On a cold memo (the env
+        never ran the prefix job, e.g. after a quarantine re-plan) fall
+        back to a full execution and SELF-HEAL the memo from it, so
+        the group's remaining siblings hit again."""
+        key = self._memo_key(prefix_hash, opts)
+        entry = self._memo_get(key)
+        if entry is None or len(entry) != n_prefix:
+            out, infos, failed, hanged = self.exec_raw(
+                opts, data, call_ids)
+            if not failed and not hanged and len(infos) > n_prefix:
+                self._memo_put(key, infos[1:n_prefix + 1])
+            return out, infos, failed, hanged, False
+        if _faults.should_fire(f"env.exec:{self.pid}"):
+            self.restarts += 1
+            return b"", [], True, False, False
+        t0 = time.perf_counter()
+        prelude = self._synth_range(opts, data, 0, 1)
+        suffix = self._synth_range(opts, data, n_prefix + 1, None)
+        infos = prelude + [_copy_info(x) for x in entry] + suffix
+        self._c_saved.inc(n_prefix)
+        self._h_exec.observe(time.perf_counter() - t0)
+        self._c_calls.inc(len(prelude) + len(suffix))
+        return b"", infos, False, False, True
 
 
 class Gate:

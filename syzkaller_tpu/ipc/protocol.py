@@ -10,6 +10,16 @@ REPLY_MAGIC = 0x73797A74707500BB
 CMD_HANDSHAKE = 1
 CMD_EXEC = 2
 CMD_QUIT = 3
+# Prefix-continuation pair (prefix-memoized batch execution): execute
+# only the first N calls of a stream and snapshot at the boundary
+# (PREFIX), or resume a snapshotted prefix and execute the remainder
+# (SUFFIX).  The current C++ executor has no fork/snapshot point, so
+# the native `Env` never sends these — they are reserved for a
+# fork-server executor; `MockEnv` implements the exact in-process
+# equivalent (memoized per-call signal spliced with a freshly executed
+# suffix) so the continuation contract is testable in tier-1.
+CMD_EXEC_PREFIX = 4
+CMD_EXEC_SUFFIX = 5
 
 # env flags (handshake)
 ENV_DEBUG = 1 << 0

@@ -419,11 +419,38 @@ class ManagerHttp:
             parts.append("<h2>admission &amp; yield</h2>"
                          + _table(["metric", "value"], adm))
 
+        # prefix-memoized execution: memo health + the calls it saved.
+        # fleet_* fallbacks carry the RPC deployment (remote engines
+        # report prefix_hits/... in their wire stats)
+        pfx = [[k, _fmt_num(snap[k])] for k in (
+            "prefix_cache_hits_total", "fleet_prefix_hits",
+            "prefix_cache_misses_total", "fleet_prefix_misses",
+            "prefix_calls_saved_total", "fleet_prefix_calls_saved",
+            "calls_executed_total") if k in snap]
+        hits = first_moving("prefix_cache_hits_total",
+                            "fleet_prefix_hits")
+        misses = first_moving("prefix_cache_misses_total",
+                              "fleet_prefix_misses")
+        if hits or misses:
+            pfx.append(["prefix_hit_rate",
+                        _fmt_num(round(hits / (hits + misses), 3))])
+        calls = first_moving("calls_executed_total")
+        if calls and execs:
+            pfx.append(["calls_executed_per_exec",
+                        _fmt_num(round(calls / execs, 2))])
+        if pfx:
+            parts.append("<h2>prefix memoization</h2>"
+                         + _table(["metric", "value"], pfx))
+
+        # drain_rows_dropped_total: rows the supervised drain gave up
+        # on — silent loss must be VISIBLE here and in /stats.json
+        # (fleet_drain_rows_dropped is the remote engines' wire stat)
         sup = [[k, _fmt_num(snap[k])] for k in (
             "env_restarts_total", "env_quarantined",
             "env_watchdog_trips_total", "env_kill_escalations_total",
             "rpc_errors_total", "rpc_retries_total",
             "device_degraded_total", "drain_rows_dropped_total",
+            "fleet_drain_rows_dropped",
             "checkpoint_age_seconds", "checkpoint_writes_total",
             "errors_total") if k in snap]
         if sup:

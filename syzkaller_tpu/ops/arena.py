@@ -132,6 +132,10 @@ class CorpusArena:
             "arena_weighted_evictions_total",
             help="arena evictions where the lowest-yield victim differed "
                  "from the FIFO (oldest-row) choice")
+        self._c_yield_decays = reg.counter(
+            "arena_yield_decays_total",
+            help="geometric age-decays applied to the arena's yield "
+                 "scores (on the admission-Bloom reset cadence)")
         ref = weakref.ref(self)
         self._gauge_fns = [
             (reg.gauge(
@@ -243,6 +247,29 @@ class CorpusArena:
             self.weights = self._set_w_fn(
                 self.weights, row,
                 jnp.uint32(self._row_weight(self.yields[row])))
+
+    def decay_yields(self, factor: float) -> None:
+        """Geometric age-decay of every row's yield score (satellite of
+        the prefix-memoization PR; ROADMAP carried-over item): called on
+        the engine's occupancy-triggered admission-Bloom reset cadence,
+        so an early-campaign jackpot row's score halves away unless the
+        row keeps earning — without decay it pins the weighted sampler
+        (and survives eviction) forever on stale credit.  One full
+        [cap] weight re-projection is uploaded per decay; the cadence
+        is Bloom resets (minutes), not launches, so this is off the hot
+        path."""
+        factor = float(factor)
+        if not 0.0 <= factor < 1.0:
+            return  # 1.0 (or junk) would be a no-op pin: skip
+        with self._lock:
+            if self.size == 0:
+                return
+            self.yields *= factor
+            w = jnp.asarray(project_weights(self.yields, self.size))
+            if self._sharding is not None:
+                w = jax.device_put(w, self._sharding)
+            self.weights = w
+            self._c_yield_decays.inc()
 
     def restore(self, cid, sval, data, *, size: int, cursor: int,
                 evictions: int = 0, yields=None, ages=None, seq: int = 0,

@@ -1,0 +1,406 @@
+"""Prefix-tree batch execution planning: run each shared mutant prefix
+ONCE, pay only for suffixes.
+
+BENCH_PR5 left the campaign host-execution-bound: the device mutates
+~33k programs/sec while the CPU fleet executes ~70/sec at ~25ms per
+exec.  Splice/insert/value mutants drawn from the same arena rows share
+long common call prefixes *by construction*, so the fleet re-executes
+the same prefix hundreds of times per batch.  This module is the next
+memoization move after ops/admission.py, grounded in the same
+literature ("Toward Speeding up Mutation Analysis by Memoizing
+Expensive Methods", arXiv:2102.11559 — memoize the expensive shared
+computation, verify with a cheap fingerprint; "Faster Mutation Analysis
+via Equivalence Modulo States", arXiv:1702.06689 — mutants whose
+observable state after the shared prefix is identical need not re-run
+it): over the staged, admission-compacted encoded batch, build a
+radix/prefix tree of longest-common call prefixes and emit an execution
+schedule of one *prefix job* per tree node plus per-program *suffix
+jobs* keyed by parent node (ipc exec_prefix/exec_suffix).
+
+Three layers, mirroring the admission module's device/host split:
+
+  - ``call_hashes`` / ``prefix_hashes`` — [B, C] per-call-slot content
+    hashes (``admission.row_hash`` applied per call slot, empty slots
+    normalized to a sentinel so inactive-slot garbage never splits a
+    group) and the FNV-chained cumulative prefix hashes.  jax versions
+    are single fused elementwise kernels; ``*_host`` are the
+    bit-identical numpy mirrors (parity-pinned by tests/test_prefix.py).
+  - ``sorted_lcp`` — vectorized longest-common-prefix discovery in the
+    ``admission.inbatch_first_mask`` style: lexicographic sort of the
+    hash rows (repeated stable argsort on device, ``np.lexsort`` on
+    host) + per-position equality cumulative-product between adjacent
+    sorted rows.
+  - ``build_plan`` — host-side lcp-interval tree construction (the
+    classic suffix-array stack algorithm) over the sorted hashes,
+    pruned to nodes that actually pay for themselves (>= 2 users,
+    >= ``min_calls`` marginal active calls), emitting a ``PrefixPlan``:
+    every eligible program is reachable as (deepest tree node's prefix)
+    + (its own suffix), and the schedule covers each row exactly once.
+
+Depth is measured in call SLOTS during tree construction (the encoded
+[B, C] layout) but exported in ACTIVE CALLS (``PrefixNode.n_calls``)
+because the ipc continuation protocol counts executed call
+instructions; the slot->call projection is the running count of
+``cid >= 0`` slots inside the shared prefix, identical across a node's
+members by construction (the hash covers ``cid``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from . import ensure_x64  # noqa: F401
+
+import numpy as np
+
+from .admission import (
+    FNV64_OFFSET,
+    FNV64_PRIME,
+    _SALT_CID,
+    _SALT_DATA,
+    _SALT_SVAL,
+)
+
+U64 = np.uint64
+
+# sentinel hash for an empty call slot (cid < 0): inactive slots carry
+# mutation garbage in sval/data that never reaches the emitted exec
+# stream, so hashing it would split groups whose executed prefixes are
+# identical.  Any fixed odd constant works; this is splitmix64's gamma.
+EMPTY_SLOT_HASH = 0x9E3779B97F4A7C15
+
+
+# ---- per-slot content hashes (device + bit-identical host mirror) ----
+
+
+def call_hashes(cid, sval, data):
+    """[B, C] i32, [B, C, S] u64, [B, C, D] u8 -> [B, C] u64: one
+    content hash per call slot, equal to ``admission.row_hash`` applied
+    to that slot's (cid, sval, data) triple (parity-pinned), with empty
+    slots (cid < 0) normalized to ``EMPTY_SLOT_HASH``.  All ops are
+    elementwise + one xor reduction per field — the jitted form is a
+    single fused kernel over the batch."""
+    import jax.numpy as jnp
+
+    from .admission import _mix
+
+    JU64 = jnp.uint64
+    cid = jnp.asarray(cid)
+    h = jnp.full(cid.shape, JU64(FNV64_OFFSET), JU64)
+    for x, salt in ((cid, _SALT_CID), (sval, _SALT_SVAL),
+                    (data, _SALT_DATA)):
+        x = jnp.asarray(x).astype(JU64).reshape(cid.shape + (-1,))
+        idx = jnp.arange(x.shape[-1], dtype=JU64)
+        w = _mix(x ^ _mix(idx + JU64(salt)))
+        folded = jax_xor_reduce(w)
+        h = _mix((h * JU64(FNV64_PRIME)) ^ folded)
+    return jnp.where(cid < 0, JU64(EMPTY_SLOT_HASH), h)
+
+
+def jax_xor_reduce(w):
+    """XOR-fold the last axis (jnp.bitwise_xor.reduce is unavailable
+    inside jit on some backends; lax.reduce is)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    return lax.reduce(w, jnp.uint64(0), lax.bitwise_xor, (w.ndim - 1,))
+
+
+def call_hashes_host(cid, sval, data) -> np.ndarray:
+    """Bit-identical numpy mirror of ``call_hashes`` (the engine's plan
+    path runs here — the compacted batch is already host numpy; the
+    device version exists for future in-step planning and parity)."""
+    from .admission import _mix_host
+
+    cid = np.asarray(cid)
+    with np.errstate(over="ignore"):
+        h = np.full(cid.shape, U64(FNV64_OFFSET), U64)
+        for x, salt in ((cid, _SALT_CID), (sval, _SALT_SVAL),
+                        (data, _SALT_DATA)):
+            x = np.asarray(x).astype(U64).reshape(cid.shape + (-1,))
+            idx = np.arange(x.shape[-1], dtype=U64)
+            w = _mix_host(x ^ _mix_host(idx + U64(salt)))
+            folded = (np.bitwise_xor.reduce(w, axis=-1) if w.shape[-1]
+                      else np.zeros(cid.shape, U64))
+            h = _mix_host((h * U64(FNV64_PRIME)) ^ folded)
+        return np.where(cid < 0, U64(EMPTY_SLOT_HASH), h)
+
+
+# ---- chained prefix hashes ----
+
+
+def prefix_hashes(h):
+    """[B, C] u64 slot hashes -> [B, C] u64 chained prefix hashes:
+    ``p[c] = mix((p[c-1] * FNV_PRIME) ^ h[c])`` with ``p[-1]`` the FNV
+    offset — ``p[b, c]`` identifies the entire slot prefix 0..c, so two
+    rows share an executed prefix iff their chained hashes match."""
+    import jax.numpy as jnp
+
+    from .admission import _mix
+
+    JU64 = jnp.uint64
+    h = jnp.asarray(h, JU64)
+    cols = []
+    p = jnp.full(h.shape[:-1], JU64(FNV64_OFFSET), JU64)
+    for c in range(h.shape[-1]):
+        p = _mix((p * JU64(FNV64_PRIME)) ^ h[..., c])
+        cols.append(p)
+    return jnp.stack(cols, axis=-1)
+
+
+def prefix_hashes_host(h) -> np.ndarray:
+    """Bit-identical numpy mirror of ``prefix_hashes``."""
+    from .admission import _mix_host
+
+    h = np.asarray(h, U64)
+    out = np.empty_like(h)
+    with np.errstate(over="ignore"):
+        p = np.full(h.shape[:-1], U64(FNV64_OFFSET), U64)
+        for c in range(h.shape[-1]):
+            p = _mix_host((p * U64(FNV64_PRIME)) ^ h[..., c])
+            out[..., c] = p
+    return out
+
+
+# ---- sort + adjacent-LCP (the inbatch_first_mask style) ----
+
+
+def sorted_lcp(h):
+    """[B, C] u64 -> (order [B] i32, lcp [B] i32) on device:
+    lexicographic row order via repeated stable argsorts (last slot
+    first — the vectorized radix idiom) and, per adjacent sorted pair,
+    the longest common prefix length in slots (cumulative product of
+    per-position equality).  ``lcp[0]`` is 0 by convention."""
+    import jax.numpy as jnp
+
+    h = jnp.asarray(h)
+    B, C = h.shape
+    order = jnp.arange(B)
+    for c in range(C - 1, -1, -1):
+        order = order[jnp.argsort(h[order, c], stable=True)]
+    hs = h[order]
+    eq = (hs[1:] == hs[:-1]).astype(jnp.int32)
+    lcp = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.sum(jnp.cumprod(eq, axis=1), axis=1, dtype=jnp.int32)])
+    return order.astype(jnp.int32), lcp
+
+
+def sorted_lcp_host(h):
+    """Bit-identical numpy mirror of ``sorted_lcp`` (np.lexsort keys
+    are last-significant-first, hence the reversed column order)."""
+    h = np.asarray(h, U64)
+    B, C = h.shape
+    if B == 0:
+        return (np.zeros(0, np.int32), np.zeros(0, np.int32))
+    order = np.lexsort(tuple(h[:, c] for c in range(C - 1, -1, -1)))
+    hs = h[order]
+    eq = (hs[1:] == hs[:-1]).astype(np.int32)
+    lcp = np.concatenate(
+        [np.zeros(1, np.int32),
+         np.sum(np.cumprod(eq, axis=1), axis=1, dtype=np.int32)])
+    return order.astype(np.int32), lcp
+
+
+# ---- lcp-interval tree -> execution schedule ----
+
+
+@dataclass
+class PrefixNode:
+    """One shared-prefix tree node: ``n_calls`` active calls (the ipc
+    continuation unit), identified by the chained ``hash`` of its
+    ``depth`` slots.  ``parent`` indexes ``PrefixPlan.nodes`` (-1 for a
+    root); a node's prefix job continues from the parent's cached
+    prefix, paying only the marginal ``n_calls - parent.n_calls``
+    calls.  ``carrier`` is the batch row whose stream the prefix job
+    executes (any subtree member — they share the prefix by
+    construction)."""
+
+    hash: int
+    depth: int              # shared prefix length in call SLOTS
+    n_calls: int            # shared prefix length in ACTIVE calls
+    parent: int = -1
+    carrier: int = -1
+    rows: List[int] = field(default_factory=list)  # direct members
+
+
+@dataclass
+class PrefixPlan:
+    """The batch execution schedule: ``nodes`` topologically ordered
+    (every parent precedes its children), ``row_node[row]`` the node
+    whose prefix the row's suffix job continues from (rows absent from
+    the dict run as ordinary full executions).  ``calls_saved_est`` is
+    the scheduling-time estimate: per grouped row its node's prefix
+    calls, minus each node's own (marginal) prefix-job cost."""
+
+    nodes: List[PrefixNode] = field(default_factory=list)
+    row_node: Dict[int, int] = field(default_factory=dict)
+    calls_saved_est: int = 0
+
+    def __bool__(self) -> bool:
+        return bool(self.nodes)
+
+
+def build_plan(cid, sval, data, rows: Optional[Sequence[int]] = None,
+               min_group: int = 2, min_calls: int = 1) -> PrefixPlan:
+    """Build the prefix-tree schedule over an encoded batch (host path:
+    the admission-compacted batch is numpy by the time the drain plans).
+
+    ``rows`` restricts planning to eligible batch rows (the engine
+    passes the rows whose exec streams emitted — decode-fallback rows
+    can't continue).  Guarantees, pinned by tests/test_prefix.py:
+
+      - laminar tree: every node's member set nests inside its parent's;
+      - coverage: each eligible row appears in ``row_node`` at most
+        once, and every ``row_node`` target exists with
+        ``n_calls >= min_calls`` and >= ``min_group`` total users;
+      - reachability: a row's program == its node's prefix (``n_calls``
+        active calls) + its own suffix, byte-equal on the hashed fields.
+    """
+    cid = np.asarray(cid)
+    B = cid.shape[0]
+    min_group = max(int(min_group), 2)
+    min_calls = max(int(min_calls), 1)
+    rows = np.asarray(range(B) if rows is None else rows, np.int64)
+    if rows.size < min_group:
+        return PrefixPlan()
+    h = call_hashes_host(cid[rows], np.asarray(sval)[rows],
+                         np.asarray(data)[rows])
+    ph = prefix_hashes_host(h)
+    # active-call running count per slot prefix: n_calls of a depth-d
+    # node is active[row, d-1] for any member
+    active = np.cumsum(cid[rows] >= 0, axis=1)
+    order, lcp = sorted_lcp_host(h)
+    n = order.size
+
+    # classic lcp-interval stack sweep (the suffix-array tree
+    # construction): emits every maximal interval of sorted rows
+    # sharing a prefix depth greater than its surroundings, children
+    # before parents (post-order); lcp[n] = 0 is the flush sentinel
+    raw: List[tuple] = []  # (depth_slots, left, right) over sorted idx
+    stack: List[tuple] = [(0, 0)]  # (depth, left boundary)
+    for i in range(1, n + 1):
+        cur = int(lcp[i]) if i < n else 0
+        lb = i - 1
+        while cur < stack[-1][0]:
+            d, left = stack.pop()
+            raw.append((d, left, i))
+            lb = left
+        if cur > stack[-1][0]:
+            stack.append((cur, lb))
+    if not raw:
+        return PrefixPlan()
+
+    # parent links: post-order emission of a laminar family means a
+    # node's parent is the first later-emitted interval containing it
+    parent = [-1] * len(raw)
+    for k, (d, l, r) in enumerate(raw):
+        for j in range(k + 1, len(raw)):
+            _dj, lj, rj = raw[j]
+            if lj <= l and r <= rj:
+                parent[k] = j
+                break
+
+    n_calls_of = [int(active[order[l], d - 1]) if d > 0 else 0
+                  for d, l, r in raw]
+
+    # collapse redundant nodes (shallow -> deep, so parents resolve
+    # first): below min_calls -> unscheduled; no marginal ACTIVE call
+    # over the effective parent -> the parent IS this prefix.  eff[k]
+    # is k itself (survives), another node (collapsed into it), or -1.
+    eff = [-1] * len(raw)
+    by_depth = sorted(range(len(raw)), key=lambda q: raw[q][0])
+
+    def eff_parent(k: int) -> int:
+        """Nearest surviving ancestor through raw parent links and
+        collapse targets.  Collapse chains are FOLLOWED, not returned:
+        a node this one merged into may itself have merged upward later
+        (the min_group cascade), so only a node with eff[p] == p — one
+        that still stands for itself — is a valid answer."""
+        p = parent[k]
+        while p >= 0:
+            if eff[p] == p:
+                return p
+            p = eff[p] if eff[p] >= 0 else parent[p]
+        return -1
+
+    for k in by_depth:
+        pe = eff_parent(k)
+        if n_calls_of[k] < min_calls:
+            eff[k] = -1
+        elif pe >= 0 and n_calls_of[k] == n_calls_of[pe]:
+            eff[k] = pe
+        else:
+            eff[k] = k
+
+    # per sorted position: deepest surviving node covering it
+    pos_node = np.full(n, -1, np.int64)
+    for k in by_depth:
+        if eff[k] == k:
+            _d, l, r = raw[k]
+            pos_node[l:r] = k
+    direct: Dict[int, List[int]] = {}
+    for pos in range(n):
+        k = int(pos_node[pos])
+        if k >= 0:
+            direct.setdefault(k, []).append(pos)
+    child_nodes: Dict[int, List[int]] = {}
+    for k in by_depth:
+        if eff[k] == k:
+            p = eff_parent(k)
+            if p >= 0:
+                child_nodes.setdefault(p, []).append(k)
+
+    # users = direct rows + surviving child nodes; a node with fewer
+    # than min_group users can't amortize its prefix job -> merge into
+    # its parent (deepest first, so merges cascade upward and parents
+    # see their final user counts when their turn comes)
+    for k in reversed(by_depth):
+        if eff[k] != k:
+            continue
+        users = len(direct.get(k, ())) + len(child_nodes.get(k, ()))
+        if users >= min_group:
+            continue
+        p = eff_parent(k)
+        eff[k] = p  # -1 at a root: members become ungrouped
+        if p >= 0:
+            direct.setdefault(p, []).extend(direct.pop(k, ()))
+            child_nodes.setdefault(p, []).extend(child_nodes.pop(k, ()))
+        else:
+            direct.pop(k, None)
+            child_nodes.pop(k, None)
+
+    final = [k for k in by_depth if eff[k] == k]
+    if not final:
+        return PrefixPlan()
+
+    # emit parents-first (shallow -> deep is a topological order for a
+    # laminar family); the carrier is the node's first sorted member —
+    # every interval member shares the node's prefix by construction
+    node_id = {k: i for i, k in enumerate(final)}
+    plan = PrefixPlan()
+    for k in final:
+        d, l, _r = raw[k]
+        p = eff_parent(k)
+        plan.nodes.append(PrefixNode(
+            hash=int(ph[order[l], d - 1]), depth=d,
+            n_calls=n_calls_of[k],
+            parent=node_id[p] if p >= 0 else -1,
+            carrier=int(rows[order[l]])))
+    for k in final:
+        nid = node_id[k]
+        for pos in direct.get(k, ()):
+            row = int(rows[order[pos]])
+            plan.nodes[nid].rows.append(row)
+            plan.row_node[row] = nid
+
+    # warm-up cost per node = its MARGINAL calls (prefix jobs continue
+    # from the parent memo and never execute the prelude — see
+    # ipc.MockEnv.exec_prefix); savings = memoized calls per suffix job
+    saved = sum(plan.nodes[nid].n_calls for nid in plan.row_node.values())
+    cost = sum(nd.n_calls - (plan.nodes[nd.parent].n_calls
+                             if nd.parent >= 0 else 0)
+               for nd in plan.nodes)
+    plan.calls_saved_est = saved - cost
+    return plan

@@ -56,6 +56,16 @@ REQUIRED_METRICS = (
     "arena_weighted_evictions_total",
     # parallel executor fan-out: env utilization of the batch drain
     "device_drain_env_occupancy",
+    # prefix-memoized batch execution (ISSUE 6): the memo's win
+    # (calls saved) and health (hit/miss) must stay auditable, the
+    # executed-call denominator must stay countable, and silent row
+    # loss + yield decay must stay visible
+    "prefix_cache_hits_total",
+    "prefix_cache_misses_total",
+    "prefix_calls_saved_total",
+    "calls_executed_total",
+    "drain_rows_dropped_total",
+    "arena_yield_decays_total",
     # device health family (ISSUE 2)
     "device_batch_occupancy",
     "device_live_buffer_bytes",

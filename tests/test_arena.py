@@ -246,3 +246,68 @@ def test_launch_path_has_no_host_stack(env, monkeypatch):
             if f.stats["device_batches"] > before:
                 break
         assert f.stats["device_batches"] > before
+
+
+def test_yield_age_decay_unpins_jackpot_rows(env):
+    """ISSUE 6 satellite (ROADMAP carried item): geometric age-decay of
+    yield scores — applied on the admission-Bloom reset cadence — lets
+    a fresher earner overtake an early-campaign jackpot row instead of
+    the jackpot pinning the weighted sampler forever."""
+    target, tables, fmt = env
+    rows = _encode_rows(target, tables, fmt, 3)
+    reg = Registry()
+    arena = CorpusArena(4, fmt, registry=reg)
+    for cid, sval, data in rows:
+        arena.append(cid, sval, data)
+    arena.credit(0, 100.0)  # early jackpot
+    arena.credit(1, 10.0)   # steady fresher earner
+    w = arena.host_weights()
+    assert w[0] > w[1]
+    for _ in range(3):
+        arena.decay_yields(0.5)
+    # decay is geometric and uniform: ordering is preserved...
+    w = arena.host_weights()
+    assert w[0] > w[1] > w[2]
+    assert arena.yields[0] == pytest.approx(12.5)
+    # ...so the jackpot only stays ahead while its lead outruns the
+    # decay: a fresh credit smaller than the ORIGINAL jackpot now
+    # flips the ordering (12.5 decayed vs 1.25 + 15)
+    arena.credit(1, 15.0)
+    w = arena.host_weights()
+    assert w[1] > w[0]
+    # the device weight tensor re-projected in lockstep with the host
+    # mirror, and live/dead row structure survived
+    np.testing.assert_array_equal(np.asarray(arena.weights),
+                                  arena.host_weights())
+    assert int(np.asarray(arena.weights)[3]) == 0  # dead row stays 0
+    assert reg.snapshot()["arena_yield_decays_total"] == 3
+    # guard band: factor 1.0 (a no-op pin) and junk are refused
+    before = arena.yields.copy()
+    arena.decay_yields(1.0)
+    arena.decay_yields(-3.0)
+    np.testing.assert_array_equal(arena.yields, before)
+    assert reg.snapshot()["arena_yield_decays_total"] == 3
+
+
+def test_engine_bloom_reset_triggers_yield_decay(env, monkeypatch):
+    """The decay rides the existing occupancy-triggered Bloom reset in
+    _DevicePipeline.candidates (one cadence, one knob)."""
+    target, tables, fmt = env
+    cfg = FuzzerConfig(mock=True, use_device=True, device_batch=8,
+                       program_length=6, device_period=1,
+                       smash_mutations=0, arena_yield_decay=0.25)
+    with Fuzzer(target, cfg) as f:
+        assert f._device is not None
+        f._add_corpus(generate(target, 11, 4), ())
+        arena = f._device.arena
+        arena.credit(0, 40.0)
+        # force the occupancy trigger: report the filter saturated
+        f._device._bloom_bits = 1  # any popcount crosses the threshold
+        y0 = float(arena.yields[0])
+        decays0 = arena._c_yield_decays.value
+        for _ in range(8):
+            f.step()
+            if arena._c_yield_decays.value > decays0:
+                break
+        assert arena._c_yield_decays.value > decays0
+        assert float(arena.yields[0]) < y0

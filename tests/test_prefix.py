@@ -1,0 +1,673 @@
+"""Prefix-memoized batch execution (ISSUE 6 tentpole): LCP-tree
+correctness properties, jax/host hash-mirror parity, MockEnv exact
+continuation (memoized prefix + suffix == full exec, bit-identical
+CallInfo signal), the per-env cache LRU bound, prefix-aware drain
+accounting, and quarantine re-plan exactly-once under the chaos fault
+harness."""
+
+import time
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+from syzkaller_tpu.descriptions.tables import get_tables  # noqa: E402
+from syzkaller_tpu.engine.fuzzer import Fuzzer, FuzzerConfig  # noqa: E402
+from syzkaller_tpu.ipc import ExecOpts, MockEnv  # noqa: E402
+from syzkaller_tpu.ops import admission  # noqa: E402
+from syzkaller_tpu.ops import prefix as pfx  # noqa: E402
+from syzkaller_tpu.prog import get_target  # noqa: E402
+from syzkaller_tpu.prog.encodingexec import serialize_for_exec  # noqa: E402
+from syzkaller_tpu.prog.generation import generate  # noqa: E402
+from syzkaller_tpu.telemetry import get_registry  # noqa: E402
+from syzkaller_tpu.testing import faults  # noqa: E402
+from syzkaller_tpu.testing.faults import FaultPlan  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def target():
+    return get_target("linux", "amd64")
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _counter(name):
+    m = get_registry().get(name)
+    return m.value if m is not None else 0
+
+
+def _mk_batch(seed=0, B=16, C=6, S=3, D=8):
+    rng = np.random.default_rng(seed)
+    cid = rng.integers(0, 50, size=(B, C)).astype(np.int32)
+    sval = rng.integers(0, 2 ** 63, size=(B, C, S)).astype(np.uint64)
+    data = rng.integers(0, 255, size=(B, C, D)).astype(np.uint8)
+    return cid, sval, data
+
+
+# ------------------------------------------------------------------ #
+# hash + LCP mirrors
+
+
+def test_call_hashes_match_admission_row_hash_per_slot():
+    """The per-slot content hash IS admission.row_hash of that slot's
+    triple (one hash family across the admission + prefix gates), with
+    empty slots normalized to the sentinel."""
+    cid, sval, data = _mk_batch(1, B=4, C=3)
+    cid[1, 1] = -1
+    h = pfx.call_hashes_host(cid, sval, data)
+    for b in range(4):
+        for c in range(3):
+            want = (pfx.EMPTY_SLOT_HASH if cid[b, c] < 0 else
+                    admission.row_hash_host(cid[b, c], sval[b, c],
+                                            data[b, c]))
+            assert int(h[b, c]) == int(want)
+
+
+def test_device_host_mirror_parity():
+    """jax call_hashes / prefix_hashes / sorted_lcp == the numpy
+    mirrors, bit for bit."""
+    import jax.numpy as jnp
+
+    cid, sval, data = _mk_batch(2, B=12, C=5)
+    cid[3:7, :2] = cid[0, :2]
+    sval[3:7, :2] = sval[0, :2]
+    data[3:7, :2] = data[0, :2]
+    cid[5, 4] = -1
+    h = pfx.call_hashes_host(cid, sval, data)
+    hj = np.asarray(pfx.call_hashes(
+        jnp.asarray(cid), jnp.asarray(sval), jnp.asarray(data)))
+    assert (h == hj).all()
+    assert (pfx.prefix_hashes_host(h)
+            == np.asarray(pfx.prefix_hashes(jnp.asarray(h)))).all()
+    oh, lh = pfx.sorted_lcp_host(h)
+    oj, lj = (np.asarray(x) for x in pfx.sorted_lcp(jnp.asarray(h)))
+    assert (oh == oj).all() and (lh == lj).all()
+
+
+def test_inactive_slot_garbage_never_splits_a_group():
+    """Two rows with identical active calls but different garbage in an
+    empty slot's sval/data hash identically (the executed stream can't
+    see the garbage, so the planner must not either)."""
+    cid, sval, data = _mk_batch(3, B=2, C=4)
+    cid[1] = cid[0]
+    sval[1] = sval[0]
+    data[1] = data[0]
+    cid[:, 2] = -1
+    sval[1, 2] ^= np.uint64(0xDEAD)
+    data[1, 2, :] = 7
+    h = pfx.call_hashes_host(cid, sval, data)
+    assert (h[0] == h[1]).all()
+
+
+# ------------------------------------------------------------------ #
+# tree / schedule properties
+
+
+def test_plan_covers_each_row_exactly_once_and_is_reachable():
+    """Every grouped program is reachable as (node prefix + own
+    suffix): the schedule assigns each row at most one node, nodes have
+    >= 2 users and >= min_calls active prefix calls, and a member's
+    first `depth` slots are byte-identical to its node carrier's."""
+    cid, sval, data = _mk_batch(4, B=24, C=6)
+    # group A: 6 rows sharing 3 slots; group B: 4 rows sharing 2 slots;
+    # nested: group A splits at depth 4 for 3 of its rows
+    for r in range(1, 6):
+        cid[r, :3], sval[r, :3], data[r, :3] = \
+            cid[0, :3], sval[0, :3], data[0, :3]
+    for r in (3, 4, 5):
+        cid[r, 3], sval[r, 3], data[r, 3] = \
+            cid[2, 3], sval[2, 3], data[2, 3]
+    for r in (7, 8, 9):
+        cid[r, :2], sval[r, :2], data[r, :2] = \
+            cid[6, :2], sval[6, :2], data[6, :2]
+    plan = pfx.build_plan(cid, sval, data, min_group=2, min_calls=1)
+    assert plan.nodes
+    seen = [r for nd in plan.nodes for r in nd.rows]
+    assert len(seen) == len(set(seen)), "a row appears in two nodes"
+    assert set(plan.row_node) == set(seen)
+    for row, nid in plan.row_node.items():
+        nd = plan.nodes[nid]
+        assert nd.n_calls >= 1
+        d = nd.depth
+        assert (cid[row, :d] == cid[nd.carrier, :d]).all()
+        assert (sval[row, :d] == sval[nd.carrier, :d]).all()
+        assert (data[row, :d] == data[nd.carrier, :d]).all()
+        # n_calls is the ACTIVE-call projection of the slot depth
+        assert nd.n_calls == int((cid[row, :d] >= 0).sum())
+    # every node amortizes: >= 2 users (direct rows + children)
+    kids = {}
+    for nid, nd in enumerate(plan.nodes):
+        if nd.parent >= 0:
+            kids.setdefault(nd.parent, []).append(nid)
+            # topological order + strictly growing prefix depth
+            assert nd.parent < nid
+            assert nd.n_calls > plan.nodes[nd.parent].n_calls
+    for nid, nd in enumerate(plan.nodes):
+        assert len(nd.rows) + len(kids.get(nid, ())) >= 2
+    # the two seeded groups both scheduled
+    assert {plan.row_node.get(r) for r in (0, 1, 2)} != {None}
+    assert {plan.row_node.get(r) for r in (6, 7, 8, 9)} != {None}
+
+
+def test_plan_respects_min_calls_and_eligible_rows():
+    cid, sval, data = _mk_batch(5, B=8, C=4)
+    for r in range(1, 4):
+        cid[r, 0], sval[r, 0], data[r, 0] = \
+            cid[0, 0], sval[0, 0], data[0, 0]
+    # depth-1 sharing only: min_calls=2 must schedule nothing
+    plan = pfx.build_plan(cid, sval, data, min_calls=2)
+    assert not plan.nodes and not plan.row_node
+    # restricting eligibility excludes rows from grouping
+    plan = pfx.build_plan(cid, sval, data, rows=[0, 1], min_calls=1)
+    assert set(plan.row_node) <= {0, 1}
+
+
+def test_min_group_merge_cascade_resolves_stale_parents():
+    """Regression: with min_group raised, a deep node can merge into an
+    ancestor that LATER merges upward itself — eff_parent must follow
+    the collapse chain to a node that still stands for itself, not
+    return a stale link absent from the emitted plan (was a KeyError).
+    Pinned with the discovered repro plus a fuzz sweep over nested
+    batches and min_group values."""
+    seqs = [[0, 8], [0, 1, 0, 1, 6], [0, 1, 0, 1, 0, 1, 7], [0, 5],
+            [0, 1, 7], [0, 1, 0, 1, 1, 0, 1, 7], [0, 1, 0, 7],
+            [0, 1, 0, 1, 1, 0, 1, 1, 5]]
+    C = max(len(s) for s in seqs)
+    B = len(seqs)
+    cid = np.full((B, C), -1, np.int32)
+    for b, s in enumerate(seqs):
+        cid[b, :len(s)] = s
+    sval = np.zeros((B, C, 2), np.uint64)
+    data = np.zeros((B, C, 4), np.uint8)
+    plan = pfx.build_plan(cid, sval, data, min_group=5, min_calls=1)
+    for nd in plan.nodes:
+        assert nd.parent < len(plan.nodes)
+    rng = np.random.default_rng(0)
+    for trial in range(50):
+        Bf, Cf = 12, 8
+        cidf = rng.integers(0, 3, size=(Bf, Cf)).astype(np.int32)
+        svalf = np.zeros((Bf, Cf, 1), np.uint64)
+        dataf = np.zeros((Bf, Cf, 1), np.uint8)
+        for mg in (2, 3, 5):
+            p = pfx.build_plan(cidf, svalf, dataf, min_group=mg)
+            for nid, nd in enumerate(p.nodes):
+                assert -1 <= nd.parent < nid
+
+
+def test_identical_rows_group_at_full_depth():
+    """A row that IS another row's prefix (or exact duplicate under a
+    hash-collision-free plan) schedules with an empty suffix instead of
+    falling out of the tree."""
+    cid, sval, data = _mk_batch(6, B=4, C=4)
+    cid[1], sval[1], data[1] = cid[0], sval[0], data[0]
+    plan = pfx.build_plan(cid, sval, data, min_calls=1)
+    assert plan.row_node.get(0) is not None
+    assert plan.row_node.get(0) == plan.row_node.get(1)
+    nd = plan.nodes[plan.row_node[0]]
+    assert nd.depth == 4 and nd.n_calls == int((cid[0] >= 0).sum())
+
+
+# ------------------------------------------------------------------ #
+# MockEnv exact continuation
+
+
+def _prog_stream(target, seed=3, n=8):
+    p = generate(target, seed, n)
+    return (serialize_for_exec(p, pid=0), [c.meta.id for c in p.calls])
+
+
+def test_mockenv_splice_is_bit_identical_to_full_exec(target):
+    env = MockEnv(target)
+    opts = ExecOpts(collect_cover=True, collect_comps=True)
+    data, cids = _prog_stream(target)
+    _, full, _, _ = env.exec_raw(opts, data, cids)
+    n_prefix = 3
+    _, pinf, failed, hanged, hit = env.exec_prefix(
+        opts, data, cids, n_prefix, prefix_hash=0xABC)
+    assert not (failed or hanged or hit)
+    # the prefix job executes calls 1..n only — never the prelude mmap
+    # (whose page budget is a whole-program property)
+    assert [i.index for i in pinf] == list(range(1, n_prefix + 1))
+    _, spliced, failed, hanged, hit = env.exec_suffix(
+        opts, data, cids, n_prefix, prefix_hash=0xABC)
+    assert hit and not (failed or hanged)
+    assert len(spliced) == len(full)
+    for a, b in zip(spliced, full):
+        assert (a.index, a.num, a.errno, a.executed, a.fault_injected,
+                a.signal, a.cover, a.comps) == \
+               (b.index, b.num, b.errno, b.executed, b.fault_injected,
+                b.signal, b.cover, b.comps)
+    # spliced infos are COPIES: mutating one result can't corrupt the
+    # memo for the next sibling
+    spliced[1].signal.append(424242)
+    _, again, *_ = env.exec_suffix(opts, data, cids, n_prefix,
+                                   prefix_hash=0xABC)
+    assert 424242 not in again[1].signal
+
+
+def test_mockenv_suffix_miss_self_heals_and_counts_saved(target):
+    env = MockEnv(target)
+    opts = ExecOpts()
+    data, cids = _prog_stream(target, seed=5)
+    before = _counter("prefix_calls_saved_total")
+    _, m, _, _, hit = env.exec_suffix(opts, data, cids, 2, prefix_hash=7)
+    assert not hit  # cold memo: full exec
+    assert _counter("prefix_calls_saved_total") == before
+    _, m2, _, _, hit = env.exec_suffix(opts, data, cids, 2, prefix_hash=7)
+    assert hit  # the full exec healed the memo
+    assert _counter("prefix_calls_saved_total") == before + 2
+    for a, b in zip(m, m2):
+        assert a.signal == b.signal and a.index == b.index
+
+
+def test_mockenv_nested_prefix_continues_from_parent(target):
+    env = MockEnv(target)
+    opts = ExecOpts()
+    data, cids = _prog_stream(target, seed=6)
+    env.exec_prefix(opts, data, cids, 2, prefix_hash=100)
+    before = _counter("prefix_calls_saved_total")
+    _, infos, _, _, hit = env.exec_prefix(
+        opts, data, cids, 4, prefix_hash=200,
+        parent_hash=100, parent_calls=2)
+    assert hit  # parent memo reused: only 2 marginal calls executed
+    assert _counter("prefix_calls_saved_total") == before + 2
+    _, full, _, _ = env.exec_raw(opts, data, cids)
+    for a, b in zip(infos, full[1:5]):  # calls 1..4 (no prelude)
+        assert a.signal == b.signal and a.index == b.index
+
+
+def test_mockenv_prefix_cache_lru_bound(target):
+    env = MockEnv(target, prefix_cache_entries=3)
+    opts = ExecOpts()
+    data, cids = _prog_stream(target, seed=7)
+    for k in range(8):
+        env.exec_prefix(opts, data, cids, 2, prefix_hash=k)
+    assert len(env._prefix_cache) == 3
+    # oldest evicted, newest retained
+    _, _, _, _, hit = env.exec_suffix(opts, data, cids, 2, prefix_hash=0)
+    assert not hit
+    _, _, _, _, hit = env.exec_suffix(opts, data, cids, 2, prefix_hash=7)
+    assert hit
+
+
+def test_mockenv_opts_key_isolates_memo_entries(target):
+    """A memo recorded without cover collection must not splice into an
+    execution that wants cover (the payloads differ)."""
+    env = MockEnv(target)
+    data, cids = _prog_stream(target, seed=8)
+    env.exec_prefix(ExecOpts(), data, cids, 2, prefix_hash=9)
+    _, _, _, _, hit = env.exec_suffix(
+        ExecOpts(collect_cover=True), data, cids, 2, prefix_hash=9)
+    assert not hit
+
+
+# ------------------------------------------------------------------ #
+# engine drain integration
+
+
+def mk(target, **kw):
+    kw.setdefault("mock", True)
+    kw.setdefault("use_device", False)
+    kw.setdefault("procs", 1)
+    return Fuzzer(target, FuzzerConfig(**kw))
+
+
+def test_device_loop_prefix_scheduling_end_to_end(target):
+    """The live mock device loop builds prefix plans, drains env-affine
+    suffix jobs, and records hits + saved calls; exec accounting stays
+    exactly consistent through the prefix jobs."""
+    cfg = FuzzerConfig(mock=True, use_device=True, device_batch=64,
+                       program_length=8, device_period=1,
+                       smash_mutations=0, generate_period=1 << 30,
+                       procs=3)
+    h0 = _counter("prefix_cache_hits_total")
+    s0 = _counter("prefix_calls_saved_total")
+    with Fuzzer(target, cfg) as f:
+        for i in range(24):
+            f._add_corpus(generate(target, 500 + i, 8), ())
+        for _ in range(200):
+            f.step()
+            if _counter("prefix_cache_hits_total") - h0 >= 10:
+                break
+        assert _counter("prefix_cache_hits_total") - h0 >= 10
+        assert _counter("prefix_calls_saved_total") - s0 > 0
+        assert f.stats.get("prefix_hits", 0) > 0  # wire-stat mirror
+        parts = ("exec_gen", "exec_fuzz", "exec_candidate", "exec_triage",
+                 "exec_minimize", "exec_smash", "exec_hints")
+        assert f.stats["exec_total"] == sum(f.stats[k] for k in parts)
+        # the plan span recorded
+        snap = get_registry().snapshot()
+        assert snap.get("span_device_prefix_plan_seconds_count", 0) > 0
+
+
+def test_prefix_schedule_off_is_the_old_drain(target):
+    """prefix_schedule=False never builds a plan nor touches the
+    continuation API (the PR5 drain, bit for bit)."""
+    cfg = FuzzerConfig(mock=True, use_device=True, device_batch=32,
+                       program_length=8, device_period=1,
+                       smash_mutations=0, generate_period=1 << 30,
+                       procs=2, prefix_schedule=False)
+    h0 = _counter("prefix_cache_hits_total")
+    m0 = _counter("prefix_cache_misses_total")
+    with Fuzzer(target, cfg) as f:
+        for i in range(8):
+            f._add_corpus(generate(target, 600 + i, 8), ())
+        for _ in range(40):
+            f.step()
+        assert f.stats["device_batches"] > 0
+    assert _counter("prefix_cache_hits_total") == h0
+    assert _counter("prefix_cache_misses_total") == m0
+
+
+class _ChaosContEnv:
+    """Continuation-capable fake env for the re-plan chaos test: records
+    which rows it executed (suffix stream byte 0 = row id), consults the
+    fault plan like ipc does, and tracks a real memo so re-planned rows
+    demonstrably self-heal on the surviving env."""
+
+    supports_continuation = True
+
+    def __init__(self, pid, delay=0.002):
+        self.pid = pid
+        self.delay = delay
+        self.rows = []
+        self.prefix_jobs = 0
+        self.fails = 0
+        self.memo = set()
+
+    def _fire(self):
+        if faults.should_fire(f"env.exec:{self.pid}"):
+            self.fails += 1
+            return True
+        return False
+
+    def exec_prefix(self, opts, data, call_ids, n_calls, prefix_hash,
+                    parent_hash=None, parent_calls=0):
+        time.sleep(self.delay)
+        if self._fire():
+            return b"", [], True, False, False
+        self.prefix_jobs += 1
+        self.memo.add(prefix_hash)
+        return b"", [], False, False, False
+
+    def exec_suffix(self, opts, data, call_ids, n_prefix, prefix_hash):
+        time.sleep(self.delay)
+        if self._fire():
+            return b"", [], True, False, False
+        hit = prefix_hash in self.memo
+        self.memo.add(prefix_hash)
+        self.rows.append(data[0])
+        return b"", [], False, False, hit
+
+    def exec_raw(self, opts, data, call_ids):
+        time.sleep(self.delay)
+        if self._fire():
+            return b"", [], True, False
+        self.rows.append(data[0])
+        return b"", [], False, False
+
+    def close(self):
+        pass
+
+
+class _FakePlanBatch:
+    """Batch stand-in with a REAL PrefixPlan injected via a stub
+    _plan_prefixes (streams carry the row id in byte 0)."""
+
+    def __init__(self, n):
+        self.streams = [bytes([r]) for r in range(n)]
+        self.dropped = 0
+
+    def __len__(self):
+        return len(self.streams)
+
+    def op_mask(self, row):
+        return 1
+
+    def src_row(self, row):
+        return -1
+
+    def src_age(self, row):
+        return -1
+
+    def call_ids(self, row):
+        return [0, 1, 2, 3]
+
+    def decode(self, row):
+        return None
+
+
+@pytest.mark.chaos
+def test_quarantine_replan_executes_group_rows_exactly_once(target):
+    """Kill one env until quarantine mid-group: its remaining suffix
+    jobs re-plan onto the survivors and every ROW still executes
+    exactly once; the re-planned rows miss (cold memo) then self-heal,
+    and prefix jobs are never retried."""
+    from syzkaller_tpu.ops.prefix import PrefixNode, PrefixPlan
+
+    plan = PrefixPlan()
+    plan.nodes.append(PrefixNode(hash=111, depth=2, n_calls=2,
+                                 carrier=0, rows=list(range(0, 10))))
+    plan.nodes.append(PrefixNode(hash=222, depth=2, n_calls=2,
+                                 carrier=10, rows=list(range(10, 20))))
+    for r in range(20):
+        plan.row_node[r] = 0 if r < 10 else 1
+    faults.install(FaultPlan(seed=3).fail_at("env.exec:0", 2, 3))
+    with mk(target, procs=2, use_device=False,
+            env_base_backoff=0.002, env_max_backoff=0.01,
+            env_quarantine_threshold=2, env_probe_interval=0.01,
+            drain_max_attempts=10) as f:
+        f.envs = [_ChaosContEnv(i) for i in range(2)]
+        f._plan_prefixes = lambda batch: plan
+        before_h = _counter("prefix_cache_hits_total")
+        f._run_device_batch_inner(_FakePlanBatch(20))
+        rows = sorted(r for e in f.envs for r in e.rows)
+        assert rows == list(range(20)), "rows lost or duplicated"
+        assert sum(e.fails for e in f.envs) >= 1, "plan never fired"
+        # both groups' members mostly hit their (possibly re-healed) memo
+        assert _counter("prefix_cache_hits_total") > before_h
+
+
+def test_dropped_rows_surface_in_wire_stats_and_supervisor(target):
+    """Satellite: rows dropped after drain_max_attempts are visible in
+    the wire stats (-> /stats.json, dashboard) and the supervisor's
+    introspection, not just the registry counter."""
+    faults.install(FaultPlan().rate("env.exec:0", 1.0)
+                   .rate("env.exec:1", 1.0))
+    before = _counter("drain_rows_dropped_total")
+    with mk(target, procs=2, use_device=False,
+            env_base_backoff=0.001, env_max_backoff=0.005,
+            env_quarantine_threshold=100, env_probe_interval=0.005,
+            drain_max_attempts=2) as f:
+        f.envs = [_ChaosContEnv(i, delay=0.0) for i in range(2)]
+        f._run_device_batch_inner(_FakePlanBatch(3))
+        assert f.stats.get("drain_rows_dropped", 0) == 3
+        assert f.supervisor.dropped_rows() == 3
+    assert _counter("drain_rows_dropped_total") == before + 3
+
+
+class _PlainEnv:
+    """Fallback fake env (NO continuation support, like the real
+    executor): grouped rows must drain off the shared overflow deque
+    (never pinned env-affine) and reuse the memoized prefix signal via
+    the engine's scanned-set."""
+
+    supports_continuation = False
+
+    def __init__(self, pid):
+        self.pid = pid
+        self.rows = []
+
+    def exec_raw(self, opts, data, call_ids):
+        time.sleep(0.002)  # force genuine worker overlap
+        self.rows.append(data[0])
+        return b"", [], False, False
+
+    def close(self):
+        pass
+
+
+def test_fallback_env_reuses_prefix_signal_without_affinity(target):
+    """Real-executor path: no prefix jobs are scheduled, every grouped
+    row still executes exactly once (dynamically balanced), the first
+    row of a group counts a miss (it pays the scan), and every sibling
+    counts a hit via the engine-global scanned-set."""
+    from syzkaller_tpu.ops.prefix import PrefixNode, PrefixPlan
+
+    plan = PrefixPlan()
+    plan.nodes.append(PrefixNode(hash=333, depth=2, n_calls=2,
+                                 carrier=0, rows=list(range(8))))
+    for r in range(8):
+        plan.row_node[r] = 0
+    h0 = _counter("prefix_cache_hits_total")
+    m0 = _counter("prefix_cache_misses_total")
+    with mk(target, procs=2, use_device=False) as f:
+        f.envs = [_PlainEnv(i) for i in range(2)]
+        f._plan_prefixes = lambda batch: plan
+        f._run_device_batch_inner(_FakePlanBatch(8))
+        rows = sorted(r for e in f.envs for r in e.rows)
+        assert rows == list(range(8))
+        # dynamic balancing preserved: both envs executed rows
+        assert all(e.rows for e in f.envs)
+    assert _counter("prefix_cache_misses_total") == m0 + 1
+    assert _counter("prefix_cache_hits_total") == h0 + 7
+
+
+def test_nested_prefix_job_skips_parent_scanned_range(target):
+    """Regression: a child node's prefix contains its parent's — the
+    child's prefix job must skip the range the parent's job already
+    novelty-scanned, or every tree level re-enqueues duplicate
+    TriageItems for it."""
+    from syzkaller_tpu.ops.prefix import PrefixNode, PrefixPlan
+
+    plan = PrefixPlan()
+    plan.nodes.append(PrefixNode(hash=41, depth=2, n_calls=2,
+                                 carrier=0, rows=[0, 1]))
+    plan.nodes.append(PrefixNode(hash=42, depth=4, n_calls=4, parent=0,
+                                 carrier=2, rows=[2, 3]))
+    skips = []
+    with mk(target, procs=1, use_device=False) as f:
+        f.envs = [_ChaosContEnv(0, delay=0.0)]
+        orig = f._scan_infos_for_triage
+        f._scan_infos_for_triage = (
+            lambda batch, row, infos, origin, skip_prefix_calls=0:
+            skips.append(skip_prefix_calls) or
+            orig(batch, row, infos, origin, skip_prefix_calls))
+        batch = _FakePlanBatch(4)
+        f._drain_prefix(batch, plan, 0, 0)   # parent: full scan
+        f._drain_prefix(batch, plan, 1, 0)   # child: parent range skipped
+    assert skips == [0, 2]
+
+
+def test_plan_gate_skips_negative_savings_on_continuation_fleet(target):
+    """A plan whose estimated splice savings can't repay its warm-up
+    round trips is not scheduled on a continuation fleet — but a
+    fallback fleet (no warm-up cost, free triage-scan reuse) keeps it."""
+    from syzkaller_tpu.ops import prefix as pfx_mod
+    from syzkaller_tpu.ops.prefix import PrefixNode, PrefixPlan
+
+    losing = PrefixPlan()
+    losing.nodes.append(PrefixNode(hash=1, depth=1, n_calls=1,
+                                   carrier=0, rows=[0, 1]))
+    losing.row_node = {0: 0, 1: 0}
+    losing.calls_saved_est = 0  # 2 saved - (1 call + 1 job) = 0
+
+    class _EncBatch(_FakePlanBatch):
+        def __init__(self, n):
+            super().__init__(n)
+            self.batch = type("E", (), {
+                "call_id": np.zeros((n, 2), np.int32),
+                "slot_val": np.zeros((n, 2, 1), np.uint64),
+                "data": np.zeros((n, 2, 1), np.uint8)})()
+
+    with mk(target, procs=1, use_device=False) as f:
+        import unittest.mock as um
+
+        with um.patch.object(pfx_mod, "build_plan",
+                             return_value=losing):
+            assert f._plan_prefixes(_EncBatch(4)) is None  # MockEnv fleet
+            f.envs = [_PlainEnv(0)]
+            assert f._plan_prefixes(_EncBatch(4)) is losing  # fallback
+
+
+def test_decode_failure_does_not_mark_prefix_scanned(target):
+    """Regression: if the first-drained row of a group can't decode
+    (codec long tail), its lost triage enqueue must NOT mark the prefix
+    hash scanned — a sibling's successful decode still rescues the
+    group's prefix coverage."""
+    from syzkaller_tpu.ipc import CallInfo
+    from syzkaller_tpu.ops.prefix import PrefixNode, PrefixPlan
+
+    plan = PrefixPlan()
+    plan.nodes.append(PrefixNode(hash=77, depth=1, n_calls=1,
+                                 carrier=0, rows=[0, 1]))
+    plan.row_node = {0: 0, 1: 0}
+
+    class _SigEnv(_PlainEnv):
+        def exec_raw(self, opts, data, call_ids):
+            self.rows.append(data[0])
+            infos = [CallInfo(index=i, num=1, errno=0, executed=True,
+                              fault_injected=False,
+                              signal=[424201 + i], cover=[], comps=[])
+                     for i in range(len(call_ids))]
+            return b"", infos, False, False
+
+    with mk(target, procs=1, use_device=False) as f:
+        f.envs = [_SigEnv(0)]
+        batch = _FakePlanBatch(2)  # decode() returns None: lost triage
+        assert f._drain_row(batch, 0, 0, node=plan.nodes[0])[0] == "ok"
+        assert not f._prefix_seen(77), \
+            "decode failure must not suppress the group's prefix scan"
+
+
+def test_exec_prefix_warm_short_circuit_executes_nothing(target):
+    """A recurring prefix job on an already-warm memo executes ZERO
+    calls (the cross-batch steady state) and reports the full saving."""
+    env = MockEnv(target)
+    opts = ExecOpts()
+    data, cids = _prog_stream(target, seed=9)
+    env.exec_prefix(opts, data, cids, 3, prefix_hash=55)
+    c0 = _counter("calls_executed_total")
+    s0 = _counter("prefix_calls_saved_total")
+    _, infos, failed, hanged, saved = env.exec_prefix(
+        opts, data, cids, 3, prefix_hash=55)
+    assert not (failed or hanged)
+    assert saved == 3
+    assert [i.index for i in infos] == [1, 2, 3]
+    assert _counter("calls_executed_total") == c0
+    assert _counter("prefix_calls_saved_total") == s0 + 3
+
+
+def test_env_memo_hit_does_not_skip_unscanned_prefix(target):
+    """Regression: an env-side memo hit alone must not skip the novelty
+    scan — the engine scanned-set is the single scan authority (the
+    carrier's scan may have failed decode, or the memo may predate the
+    scanned-set LRU window).  The first sibling with a warm memo still
+    draws the (one, atomic) scan duty; the second skips."""
+    from syzkaller_tpu.ops.prefix import PrefixNode, PrefixPlan
+
+    plan = PrefixPlan()
+    plan.nodes.append(PrefixNode(hash=888, depth=2, n_calls=2,
+                                 carrier=0, rows=[0, 1]))
+    plan.row_node = {0: 0, 1: 0}
+    skips = []
+    with mk(target, procs=1, use_device=False) as f:
+        env = _ChaosContEnv(0, delay=0.0)
+        env.memo.add(888)  # warm memo, but engine never scanned it
+        f.envs = [env]
+        orig = f._scan_infos_for_triage
+        f._scan_infos_for_triage = (
+            lambda batch, row, infos, origin, skip_prefix_calls=0:
+            skips.append(skip_prefix_calls) or
+            orig(batch, row, infos, origin, skip_prefix_calls))
+        batch = _FakePlanBatch(2)
+        assert f._drain_row(batch, 0, 0, node=plan.nodes[0])[0] == "ok"
+        assert f._drain_row(batch, 1, 0, node=plan.nodes[0])[0] == "ok"
+    # both were memo hits, yet the first still scanned the full range
+    assert skips == [0, 2]

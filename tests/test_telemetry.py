@@ -439,11 +439,24 @@ def test_bench_json_line_schema(monkeypatch, capsys):
     monkeypatch.setattr(bench, "bench_host_mutate", lambda target: 10.0)
     monkeypatch.setattr(bench, "bench_cover_merge", lambda: (20.0, 2.0))
     monkeypatch.setattr(bench, "bench_hints", lambda: (30.0, 3.0))
-    # e2e-style configs return (rate, execs, new_inputs) per side so the
-    # JSON line can report execs-per-new-input (yield efficiency)
+    # e2e-style configs return (rate, execs, new_inputs, efficiency)
+    # per side so the JSON line can report execs-per-new-input (yield
+    # efficiency) and calls-per-exec (prefix memoization)
+    dev_eff = {"calls_executed_per_exec": 2.5, "prefix_hit_rate": 0.5,
+               "prefix_calls_saved": 10}
     monkeypatch.setattr(bench, "bench_e2e",
-                        lambda target: ((40.0, 400, 4), (4.0, 40, 2),
-                                        "mock"))
+                        lambda target: ((40.0, 400, 4, dev_eff),
+                                        (4.0, 40, 2, {}), "mock"))
+    monkeypatch.setattr(
+        bench, "bench_prefix_sweep",
+        lambda target: {f"len{n}": {
+            "off": {"execs_per_sec": 2.0, "batches": 3,
+                    "calls_executed_per_exec": 4.0},
+            "on": {"execs_per_sec": 3.0, "batches": 3,
+                   "calls_executed_per_exec": 2.4,
+                   "prefix_hit_rate": 0.8},
+            "calls_reduction": 0.4}
+            for n in bench.PREFIX_SWEEP_LENGTHS})
     monkeypatch.setattr(
         bench, "bench_arena_sweep",
         lambda target: {str(c): {"execs_per_sec": 1.0, "new_inputs": 1,
@@ -464,11 +477,19 @@ def test_bench_json_line_schema(monkeypatch, capsys):
     e2e = doc["configs"]["e2e_triage"]
     assert e2e["execs_per_new_input"] == {"device": 100.0, "host": 20.0}
     assert e2e["new_inputs"] == {"device": 4, "host": 2}
+    # executed-call efficiency (prefix memoization) rides the e2e line
+    # getattr-tolerantly: the host side reports an (empty) dict too
+    assert e2e["efficiency"]["device"]["calls_executed_per_exec"] == 2.5
+    assert e2e["efficiency"]["host"] == {}
     sweep = doc["configs"]["arena_sweep"]
     for cap in bench.ARENA_SWEEP_CAPACITIES:
         assert "execs_per_new_input" in sweep[str(cap)]
+    psweep = doc["configs"]["prefix_depth_sweep"]
+    for n in bench.PREFIX_SWEEP_LENGTHS:
+        assert "calls_reduction" in psweep[f"len{n}"]
     for name in ("mutate", "cover_merge_10k", "hints_100k",
-                 "e2e_triage", "arena_sweep", "hub_sync"):
+                 "e2e_triage", "arena_sweep", "hub_sync",
+                 "prefix_depth_sweep"):
         cfg = doc["configs"][name]
         assert "error" not in cfg
         spans = cfg["spans"]
