@@ -272,6 +272,10 @@ def _exec_efficiency(delta, execs, batches=0):
         "prefix_hit_rate": (round(hits / max(hits + misses, 1), 3)
                             if (hits or misses) else None),
         "prefix_calls_saved": delta.get("prefix_calls_saved_total", 0),
+        # campaign-journal volume of the timed window (0 when the
+        # config runs without a workdir/journal): the durability layer's
+        # cost must be visible in BENCH deltas, not assumed free
+        "journal_records": delta.get("journal_records_total", 0),
     }
     if batches:
         out["calls_per_batch"] = (round(calls / batches, 1)
@@ -288,11 +292,15 @@ def bench_e2e(target, seconds=18.0):
     def run(use_device: bool, mock: bool):
         # the device pipeline drains batches across an executor fleet
         # (ISSUE 3 fan-out); the host-only loop stays the 1-proc
-        # single-threaded reference baseline
+        # single-threaded reference baseline.  A per-run workdir keeps
+        # the campaign journal LIVE so its cost (and record volume)
+        # shows in the e2e numbers instead of being benched away
         cfg = FuzzerConfig(
             mock=mock, use_device=use_device, device_batch=256,
             program_length=16, device_period=2, smash_mutations=4,
-            procs=E2E_DEVICE_PROCS if use_device else 1)
+            procs=E2E_DEVICE_PROCS if use_device else 1,
+            workdir=tempfile.mkdtemp(
+                prefix=f"syztpu-e2e-{'dev' if use_device else 'host'}-"))
         with Fuzzer(target, cfg) as f:
             rate, execs, ni, delta = _timed_loop(f, seconds, reg)
             return rate, execs, ni, _exec_efficiency(delta, execs)

@@ -39,6 +39,7 @@ from ..telemetry import (
     timed,
 )
 from ..telemetry import attribution as _attr
+from ..telemetry import journal as _journal
 from ..testing import faults as _faults
 from ..prog.encoding import serialize
 from ..prog.generation import RandGen, generate
@@ -125,6 +126,14 @@ class FuzzerConfig:
     # occupancy-triggered admission-Bloom reset so early-campaign
     # jackpot rows stop pinning the weighted sampler forever
     arena_yield_decay: float = 0.5
+    # ---- durable campaign journal (telemetry/journal.py) ----
+    # enabled whenever a workdir is configured: every state transition
+    # (checkpoints, env supervision, degradation, admission resets,
+    # corpus adds) lands in <workdir>/journal.jsonl, bounded by
+    # journal_max_bytes * journal_segments and replayable offline
+    journal: bool = True
+    journal_max_bytes: int = 4 << 20
+    journal_segments: int = 4
 
 
 class ManagerConn:
@@ -141,7 +150,7 @@ class ManagerConn:
         pass
 
     def poll(self, stats: Dict[str, int], need_candidates: bool,
-             new_signal: Sequence[int] = ()):
+             new_signal: Sequence[int] = (), ledger=None):
         return {"new_inputs": [], "candidates": [], "max_signal": []}
 
 
@@ -276,6 +285,33 @@ class Fuzzer:
         for g, fn in self._gauge_fns:
             g.set_fn(fn)
 
+        # device pipeline fields exist BEFORE the manager connect below:
+        # a manager with a corpus hands it over at connect time, and
+        # _add_corpus consults self._device for every import (the
+        # pipeline itself is built after the env fleet)
+        self._device = None
+        self._max_bits = None  # device bitset mirror of max_signal
+
+        # ---- durable identity + campaign journal (before anything
+        # that emits: manager connect imports seed corpus entries) ----
+        # engine_id is minted once per workdir (ephemeral without one)
+        # and stamped into wire stats, checkpoints, and every journal
+        # record, so a --resume run continues the SAME trajectory and
+        # fleet tooling can dedup/attribute by engine
+        if self.cfg.workdir:
+            os.makedirs(self.cfg.workdir, exist_ok=True)
+        self.engine_id = _journal.mint_engine_id(self.cfg.workdir)
+        self._journal: Optional[_journal.CampaignJournal] = None
+        if self.cfg.workdir and self.cfg.journal:
+            self._journal = _journal.CampaignJournal(
+                os.path.join(self.cfg.workdir, _journal.JOURNAL_NAME),
+                engine_id=self.engine_id,
+                max_bytes=self.cfg.journal_max_bytes,
+                segments=self.cfg.journal_segments)
+            self._jemit("campaign_start", resume=bool(self.cfg.resume),
+                        procs=self.cfg.procs, mock=self.cfg.mock,
+                        device=self.cfg.use_device)
+
         conn = self.manager.connect()
         self._enabled = conn.get("enabled")
         if self.cfg.detect_supported:
@@ -311,7 +347,7 @@ class Fuzzer:
             max_backoff=self.cfg.env_max_backoff,
             probe_interval=self.cfg.env_probe_interval,
             watchdog_seconds=self.cfg.env_watchdog_seconds,
-            seed=seed)
+            seed=seed, on_event=self._jemit)
 
         self._leak = None
         self.leak_reports = []
@@ -321,11 +357,10 @@ class Fuzzer:
 
             self._leak = Kmemleak()
 
-        self._device = None
-        self._max_bits = None  # device bitset mirror of max_signal
         if self.cfg.use_device:
             try:
-                self._device = _DevicePipeline(target, self.cfg)
+                self._device = _DevicePipeline(target, self.cfg,
+                                               journal=self._jemit)
                 import numpy as _np
 
                 # the mirror indexes by low hash bits: must be a power of
@@ -335,6 +370,14 @@ class Fuzzer:
             except Exception as e:
                 count_error("device_init", e)
                 self._device = None  # no jax available: host-only mode
+            if self._device is not None:
+                # corpus imported at connect time predates the pipeline:
+                # seed the arena so the device path starts on the full
+                # corpus instead of waiting for fresh triage adds
+                with self._lock:
+                    seeded = list(self.corpus)
+                for p in seeded:
+                    self._device.add_corpus(p)
 
         self._iter = 0
 
@@ -343,15 +386,28 @@ class Fuzzer:
         self.checkpoint_path = (
             os.path.join(self.cfg.workdir, "engine.ckpt")
             if self.cfg.workdir else "")
-        if self.cfg.workdir:
-            os.makedirs(self.cfg.workdir, exist_ok=True)
         self._next_ckpt = time.monotonic() + max(
             self.cfg.checkpoint_interval, 0.0)
         if self.cfg.resume and self.checkpoint_path and \
                 os.path.exists(self.checkpoint_path):
             self.restore()
 
+        # install as the process-global hook LAST — far call sites (RPC
+        # reconnects, manager crash saves) emit through it, and a failed
+        # __init__ (manager down, bad checkpoint config) must not leave
+        # the hook pointing at an orphaned journal, blocking the next
+        # engine's install; the first live journal owns the hook and
+        # close() releases it
+        if self._journal is not None and _journal.get_journal() is None:
+            _journal.install(self._journal)
+
     # ---- lifecycle ----
+
+    def _jemit(self, ev: str, **fields) -> None:
+        """Emit one campaign-journal event (no-op without a workdir) —
+        the single funnel the supervisor/device/checkpoint hooks share."""
+        if self._journal is not None:
+            self._journal.emit(ev, **fields)
 
     def close(self) -> None:
         if self._drain_pool is not None:
@@ -364,6 +420,20 @@ class Fuzzer:
             g.clear_fn(fn)
         if self._device is not None:
             self._device.close()
+        # flush-on-exit: the terminal record + fsync make the clean-exit
+        # journal durable end-to-end (a SIGKILL'd engine instead loses
+        # at most the last in-flight record — the chaos-pinned bound)
+        if self._journal is not None:
+            with self._stats_lock:
+                execs = self.stats.get("exec_total", 0)
+                ni = self.stats.get("new_inputs", 0)
+            self._journal.emit("campaign_end", execs=execs,
+                               new_inputs=ni,
+                               signal=len(self.max_signal))
+            if _journal.get_journal() is self._journal:
+                _journal.install(None)
+            self._journal.close()
+            self._journal = None
 
     def __enter__(self):
         return self
@@ -388,6 +458,8 @@ class Fuzzer:
             # exec paid, no new_inputs bump — triaged work never lands
             # here), so seed volume is auditable next to earned yield
             self._ledger.record_corpus_add(_attr.PHASE_SEED)
+            self._jemit("corpus_add", phase=_attr.PHASE_SEED,
+                        h=hash_str(text.encode())[:16])
 
     def _push_candidate_text(self, text: str) -> None:
         from ..prog.encoding import deserialize
@@ -553,6 +625,13 @@ class Fuzzer:
             _attr.PHASE_CANDIDATE if item.from_candidate
             else _attr.PHASE_MUTATE)
         self._ledger.record_new_signal(origin.phase, origin.ops, fresh)
+        if fresh:
+            # event-sourced signal trajectory: each accepted new-signal
+            # batch is one journal record with full provenance, so
+            # replay() rebuilds new_signal_total bit-exactly offline
+            self._jemit("signal", n=fresh, phase=origin.phase,
+                        ops=list(origin.ops),
+                        row=getattr(origin, "row", -1))
         # yield-weighted scheduling feedback: new signal (and, below,
         # the corpus addition) credits the arena row the candidate was
         # sampled from, so the on-device weighted draw favors proven
@@ -572,6 +651,10 @@ class Fuzzer:
         self.stats["new_inputs"] += 1
         self._m_new_inputs.inc()
         self._ledger.record_corpus_add(origin.phase, origin.ops)
+        self._jemit("corpus_add", phase=origin.phase,
+                    ops=list(origin.ops), row=getattr(origin, "row", -1),
+                    sig=len(sig_list),
+                    h=hash_str(serialize(item.prog).encode())[:16])
         self._report_new_input(serialize(item.prog), item.call_index,
                                sig_list, sorted(cover))
         self.queue.push_smash(SmashItem(item.prog, item.call_index))
@@ -1348,10 +1431,21 @@ class Fuzzer:
         net under it."""
         with self._stats_lock:
             stats = dict(self.stats)
+        # wire-stat identity stamp: the manager pops the (string) id
+        # before folding the numeric counters, keyed per engine so
+        # restart-aware attribution can follow one engine across
+        # processes; the ledger rides along as an absolute state the
+        # manager keeps latest-wins per engine (proc-token-guarded so
+        # an in-process fuzzer, whose credit already lives in the
+        # shared process-global ledger, is never double-counted)
+        stats["engine_id"] = self.engine_id
         try:
             _faults.fire("rpc.poll")
             r = self.manager.poll(stats, need_candidates=not self.corpus,
-                                  new_signal=sorted(self.new_signal))
+                                  new_signal=sorted(self.new_signal),
+                                  ledger={"proc": _journal.PROC_TOKEN,
+                                          "engine_id": self.engine_id,
+                                          "state": self._ledger.state()})
         except Exception as e:
             count_error("rpc_poll", e)
             return
@@ -1388,6 +1482,7 @@ class Fuzzer:
         with self._stats_lock:
             stats = dict(self.stats)
         state = {
+            "engine_id": self.engine_id,
             "stats": stats,
             "corpus": corpus,
             "corpus_signal": corpus_signal,
@@ -1443,6 +1538,15 @@ class Fuzzer:
         self._last_ckpt_time = time.time()
         self._next_ckpt = time.monotonic() + max(
             self.cfg.checkpoint_interval, 0.0)
+        with self._stats_lock:
+            execs = self.stats.get("exec_total", 0)
+            ni = self.stats.get("new_inputs", 0)
+        self._jemit("checkpoint_save", bytes=n, execs=execs,
+                    new_inputs=ni, signal=len(self.max_signal))
+        if self._journal is not None:
+            # checkpoint durability extends to the journal: everything
+            # the checkpoint's trajectory claims is on disk too
+            self._journal.sync()
         return n
 
     def maybe_checkpoint(self, force: bool = False) -> bool:
@@ -1475,15 +1579,27 @@ class Fuzzer:
         except _ckpt.CheckpointError as e:
             self._m_ckpt_rejected.inc()
             count_error("checkpoint_load", e)
+            self._jemit("checkpoint_reject", reason=str(e)[:200])
             return False
         try:
             self._apply_checkpoint(st)
         except Exception as e:
             self._m_ckpt_rejected.inc()
             count_error("checkpoint_apply", e)
+            self._jemit("checkpoint_reject", reason=str(e)[:200])
             return False
         self._m_ckpt_restores.inc()
         self._last_ckpt_time = time.time()
+        with self._stats_lock:
+            execs = self.stats.get("exec_total", 0)
+            ni = self.stats.get("new_inputs", 0)
+        # the restore marker lets replay() reconcile counter rewinds:
+        # journal records postdating the restored checkpoint describe
+        # work a kill threw away (the journal is a superset of the
+        # checkpoint by design)
+        self._jemit("checkpoint_restore", execs=execs, new_inputs=ni,
+                    signal=len(self.max_signal),
+                    ckpt_engine=str(st.get("engine_id", "")))
         return True
 
     def _apply_checkpoint(self, st: dict) -> None:
@@ -1603,7 +1719,7 @@ class _DevicePipeline:
     test, ops/admission.py).  Triage-confirmed yield credits back to the
     sampled arena rows, closing the scheduling loop."""
 
-    def __init__(self, target, cfg: FuzzerConfig):
+    def __init__(self, target, cfg: FuzzerConfig, journal=None):
         import jax
         import jax.numpy as jnp
         import numpy as np
@@ -1616,6 +1732,10 @@ class _DevicePipeline:
         from ..prog.tensor import ProgBatch, TensorFormat, encode_prog
 
         self._jax = jax
+        # campaign-journal emit hook (the owning Fuzzer's _jemit); the
+        # degradation ladder and admission resets are exactly the state
+        # transitions the journal exists to make replayable
+        self._jemit = journal or (lambda ev, **fields: None)
         self.tables = get_tables(target)
         self.fmt = TensorFormat.for_tables(
             self.tables, max_calls=cfg.program_length)
@@ -1745,6 +1865,7 @@ class _DevicePipeline:
             try:
                 if rung == "recompile":
                     self._c_step_recompiles.inc()
+                    self._jemit("device_degrade", rung="recompile")
                     self._step, self._shardings = \
                         pmesh.make_arena_fuzz_step(
                             self.mesh, self.dt, batch=self.B,
@@ -1755,8 +1876,10 @@ class _DevicePipeline:
                 self._heal_donated_buffers()
                 if rung == "try":
                     self._c_step_retries.inc()
+                    self._jemit("device_degrade", rung="retry")
         self.degraded = True
         self._c_degraded.inc()
+        self._jemit("device_degrade", rung="host_fallback")
         from ..utils.log import logf
 
         logf(0, "device pipeline degraded to host mutation path "
@@ -1871,6 +1994,8 @@ class _DevicePipeline:
             # cadence: early-campaign jackpot rows must keep earning to
             # keep their weighted-sampler pin (ROADMAP carried item)
             self.arena.decay_yields(self._yield_decay)
+            self._jemit("bloom_reset", occupancy=round(occ, 4),
+                        yield_decay=self._yield_decay)
         if keep.size < total:
             cid, sval, data = cid[keep], sval[keep], data[keep]
             op_mask, idx = op_mask[keep], idx[keep]

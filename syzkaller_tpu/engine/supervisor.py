@@ -51,7 +51,8 @@ class EnvSupervisor:
                  base_backoff: float = 0.05, max_backoff: float = 5.0,
                  probe_interval: float = 1.0,
                  watchdog_seconds: float = 0.0, seed: int = 0,
-                 registry=None, time_fn=time.monotonic):
+                 registry=None, time_fn=time.monotonic,
+                 on_event=None):
         self.n_envs = max(int(n_envs), 1)
         self.quarantine_threshold = max(int(quarantine_threshold), 1)
         self.base_backoff = float(base_backoff)
@@ -62,6 +63,11 @@ class EnvSupervisor:
         self._rng = random.Random(seed)
         self._lock = threading.Lock()
         self._envs = [_EnvState() for _ in range(self.n_envs)]
+        # state-transition hook (the engine's campaign-journal emit):
+        # called OUTSIDE the supervisor lock with (event, **fields);
+        # failures in the hook are swallowed — observability must never
+        # take down the supervision it observes
+        self._on_event = on_event
 
         reg = registry or get_registry()
         self._c_restarts = reg.counter(
@@ -125,6 +131,7 @@ class EnvSupervisor:
         with self._lock:
             st = self._envs[env_idx]
             st.failures += 1
+            failures = st.failures
             self._c_restarts.inc()
             backoff = min(self.max_backoff,
                           self.base_backoff *
@@ -132,10 +139,15 @@ class EnvSupervisor:
             backoff *= 0.5 + self._rng.random()  # jitter in [0.5, 1.5)
             st.last_backoff = backoff
             st.not_before = self._time() + backoff
+            quarantined = False
             if not st.quarantined and \
                     st.failures >= self.quarantine_threshold:
-                st.quarantined = True
+                st.quarantined = quarantined = True
                 self._update_quarantine_gauge_locked()
+        self._emit("env_restart", env=env_idx, failures=failures,
+                   backoff=round(backoff, 4))
+        if quarantined:
+            self._emit("env_quarantine", env=env_idx, failures=failures)
 
     def record_success(self, env_idx: int) -> None:
         """A clean exec on ``env_idx``: reset failures and, if this was
@@ -144,9 +156,22 @@ class EnvSupervisor:
             st = self._envs[env_idx]
             st.failures = 0
             st.not_before = 0.0
+            unquarantined = False
             if st.quarantined:
                 st.quarantined = False
+                unquarantined = True
                 self._update_quarantine_gauge_locked()
+        if unquarantined:
+            self._emit("env_unquarantine", env=env_idx)
+
+    def _emit(self, ev: str, **fields) -> None:
+        cb = self._on_event
+        if cb is None:
+            return
+        try:
+            cb(ev, **fields)
+        except Exception:
+            pass  # journaling must never take down supervision
 
     def record_dropped(self, n: int = 1) -> None:
         """The drain exhausted a row's retries across envs: the work is
@@ -219,6 +244,7 @@ class EnvSupervisor:
         poll = max(self.watchdog_seconds / 4.0, 0.005)
         while not self._stop.wait(poll):
             now = self._time()
+            trips = []
             with self._lock:
                 # interrupt UNDER the lock: a worker whose expired call
                 # just returned blocks in _arm until the kill lands, so
@@ -230,12 +256,15 @@ class EnvSupervisor:
                         continue
                     del self._inflight[k]  # one trip per call
                     self._c_watchdog.inc()
+                    trips.append(k)
                     interrupt = getattr(env, "interrupt", None)
                     if interrupt is not None:
                         try:
                             interrupt()
                         except Exception:
                             pass  # env already died: worker unblocks anyway
+            for k in trips:
+                self._emit("env_watchdog", env=k)
 
     def close(self) -> None:
         self._stop.set()

@@ -23,7 +23,8 @@ from typing import Dict, List, Optional, Sequence, Set
 from ..db import DB
 from ..prog.encoding import deserialize, serialize
 from ..prog.prio import calculate_priorities
-from ..telemetry import get_registry, timed
+from ..telemetry import get_registry, journal_emit, timed
+from ..telemetry import journal as _journal
 from ..utils.hash import hash_str
 from ..vm import VMConfig
 from .rpc import RpcServer
@@ -133,6 +134,20 @@ class Manager:
         # absolute per-fuzzer counter snapshots (summed for reporting);
         # a single shared dict would flip-flop between fuzzers' values
         self._fuzzer_stats: Dict[str, Dict[str, int]] = {}
+        # cross-restart / cross-engine attribution (ISSUE 7): engines
+        # stamp a persistent engine_id into their wire stats and ship
+        # their attribution-ledger state on every poll; the manager
+        # keeps the LATEST absolute state per engine (replace, never
+        # accumulate — the state is already cumulative) so the merged
+        # fleet ledger stays exact across engine restarts.  The
+        # manager's own id is minted per workdir like an engine's.
+        self.engine_id = _journal.mint_engine_id(cfg.workdir)
+        self._engine_ids: Dict[str, str] = {}
+        self._engine_ledgers: Dict[str, Dict] = {}
+        # proc token per stored ledger: a PROCESS has one global ledger,
+        # so two fuzzers sharing a process ship identical state — only
+        # one copy may survive or the merge double-counts every cell
+        self._engine_ledger_procs: Dict[str, str] = {}
         self.connected_fuzzers: Set[str] = set()
         self.crashes: Dict[str, CrashEntry] = {}
         self.max_signal: Set[int] = set()
@@ -324,10 +339,17 @@ class Manager:
         return {}
 
     def on_poll(self, name: str, stats: Dict[str, int],
-                need_candidates: bool, new_signal: Sequence[int]):
+                need_candidates: bool, new_signal: Sequence[int],
+                ledger=None):
         fleet_deltas: Dict[str, int] = {}
         with self._lock:
             if stats:
+                stats = dict(stats)
+                # the engine's persistent identity rides the wire stats
+                # as a string — pop it before the numeric fold
+                eid = stats.pop("engine_id", None)
+                if eid:
+                    self._engine_ids[name] = str(eid)
                 snap = {k: int(v) for k, v in stats.items()}
                 prev = self._fuzzer_stats.get(name, {})
                 # fleet_-prefixed registry counters carry remote fuzzers'
@@ -343,6 +365,26 @@ class Manager:
                     if dv > 0:
                         fleet_deltas[k] = dv
                 self._fuzzer_stats[name] = snap
+            if isinstance(ledger, dict) and ledger.get("state") and \
+                    ledger.get("proc") != _journal.PROC_TOKEN:
+                # latest-wins absolute ledger state per REMOTE engine
+                # PROCESS; an in-process fuzzer's credit already lives
+                # in the shared process-global ledger (same proc
+                # token), and two remote fuzzers sharing one process
+                # ship the same process-global state under different
+                # names — either duplicate would double-count in the
+                # merged view, so one copy per proc token survives
+                proc = str(ledger.get("proc") or "")
+                if proc:
+                    for other, op in list(
+                            self._engine_ledger_procs.items()):
+                        if op == proc and other != name:
+                            self._engine_ledgers.pop(other, None)
+                            self._engine_ledger_procs.pop(other, None)
+                    self._engine_ledger_procs[name] = proc
+                self._engine_ledgers[name] = ledger["state"]
+                if ledger.get("engine_id"):
+                    self._engine_ids[name] = str(ledger["engine_id"])
             self._note_signal(new_signal)
             cur = self._signal_cursor.get(name, 0)
             delta = self._signal_log[cur:]
@@ -386,6 +428,50 @@ class Manager:
         with self._lock:
             return dict(self._stats_local)
 
+    # ---- cross-engine attribution (ISSUE 7) ----
+
+    def engines_info(self) -> Dict[str, Dict[str, object]]:
+        """Connected fuzzers with their persistent engine ids (None for
+        engines that predate the id stamp) — the /stats.json `engines`
+        map fleet tooling attributes by."""
+        with self._lock:
+            return {name: {"engine_id": self._engine_ids.get(name)}
+                    for name in sorted(self.connected_fuzzers
+                                       | set(self._engine_ids))}
+
+    def attribution_state(self) -> Dict[str, object]:
+        """The exact (raw-count) attribution picture this manager can
+        vouch for, structured so a fleet aggregator can merge WITHOUT
+        double-counting: the process-global ledger once per process
+        (keyed by proc token — several managers can share one process)
+        plus the latest absolute state each remote engine shipped
+        (keyed by name, engine_id alongside for cross-manager dedup)."""
+        from ..telemetry import get_ledger
+
+        with self._lock:
+            engines = {name: {"engine_id": self._engine_ids.get(name),
+                              "proc": self._engine_ledger_procs.get(name),
+                              "state": st}
+                       for name, st in self._engine_ledgers.items()}
+        return {"proc": _journal.PROC_TOKEN,
+                "local": get_ledger().state(),
+                "engines": engines}
+
+    def merged_attribution_state(self) -> Dict[str, Dict]:
+        """One exact merged ledger state over this manager's view: the
+        process-local ledger + every remote engine's latest state
+        (merged phase totals == local totals + sum of engines' totals;
+        pinned by the fleet tests)."""
+        from ..telemetry import AttributionLedger, get_ledger
+
+        merged = AttributionLedger()
+        merged.merge_state(get_ledger().state())
+        with self._lock:
+            states = list(self._engine_ledgers.values())
+        for st in states:
+            merged.merge_state(st)
+        return merged.state()
+
     # ---- crash persistence (reference saveCrash manager.go:570-640) ----
 
     def save_crash(self, report, output: bytes, vm_index: int = -1) -> str:
@@ -424,6 +510,9 @@ class Manager:
             with open(os.path.join(d, f"report{seq}"), "w") as f:
                 f.write(report.report)
         self._bump("crashes")
+        # campaign-journal crash forensics (no-op without an installed
+        # journal): which crash, when, attributed to which VM slot
+        journal_emit("crash", title=title, vm=vm_index)
         return d
 
     def save_repro(self, title: str, prog_text: str,
@@ -615,5 +704,6 @@ class _RpcHandler:
                                       signal, cover)
 
     def poll(self, name: str, stats, need_candidates: bool,
-             new_signal=()):
-        return self._mgr.on_poll(name, stats, need_candidates, new_signal)
+             new_signal=(), ledger=None):
+        return self._mgr.on_poll(name, stats, need_candidates, new_signal,
+                                 ledger=ledger)

@@ -1,0 +1,532 @@
+"""Fleet aggregator: one pane of glass over N managers.
+
+The reference system's production story is a *fleet* — many managers,
+each with many fuzzers, syncing through a hub — and at that scale the
+question stops being "how fast is this engine" and becomes "what is the
+fleet doing, and which engine did what".  This module is the
+observability half of fleet federation (ROADMAP): a poller that scrapes
+N managers' ``/stats.json`` and serves
+
+  - ``/fleet.json`` — restart-aware folded fleet counters (monotonic
+    across engine restarts), summed fleet gauges, per-engine health
+    (online / stale / unreachable — never silently dropped), bounded
+    aggregate time series, and the EXACT merged attribution ledger
+    (``AttributionLedger.merge_state`` over each manager's
+    ``attribution_state``, deduped by process token for in-process
+    ledgers and by engine id for remote engines, so an engine polled
+    through two managers — or a restarted engine — is counted once);
+  - ``/fleet`` — an HTML dashboard: aggregate exec/signal/crash
+    sparklines, per-engine health + yield tables, merged per-operator
+    attribution.
+
+Counter folding reuses the ``rate_points`` clamp semantics: per engine
+and per counter the aggregator adds ``max(v - prev, 0)`` — a counter
+that went backwards means the engine restarted, and the clamp keeps the
+fleet aggregate monotonic without double-counting the restart's replay
+(the engine's ``--resume`` restores its counters from the checkpoint,
+so the post-restart values catch back up to ``prev`` and folding
+resumes exactly where it left off).
+
+Scrape targets are ``host:port`` of a manager HTTP UI (or a full
+``/stats.json`` URL).  A target that stops answering is marked
+``unreachable`` but its last-known contribution stays in the aggregate
+— a dead manager must dent the fleet's *rate*, not rewrite its
+*history*.  Runnable standalone::
+
+    python -m syzkaller_tpu.manager.fleet \
+        --managers 127.0.0.1:56741,127.0.0.1:56743 --http 127.0.0.1:8050
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..telemetry import (
+    AttributionLedger,
+    TimeSeriesStore,
+    get_registry,
+    rate_points,
+)
+
+FLEET_SCHEMA_VERSION = 1
+
+# snapshot keys that are point-in-time values: the fleet aggregate is
+# the SUM OF LATEST over engines with data, not a delta fold (folding a
+# gauge as a counter would ratchet it upward forever)
+GAUGE_KEYS = frozenset({
+    "uptime_s", "phase", "corpus", "signal", "candidates", "fuzzers",
+    "crash_types",
+})
+
+STATUS_ONLINE = "online"
+STATUS_STALE = "stale"
+STATUS_UNREACHABLE = "unreachable"
+
+
+class _Engine:
+    """Scrape-side state for one managed target."""
+
+    __slots__ = ("target", "url", "name", "engine_id", "doc", "last_ok",
+                 "last_attempt", "last_error", "scrapes", "errors",
+                 "prev")
+
+    def __init__(self, target: str):
+        self.target = target
+        self.url = (target if "://" in target
+                    else f"http://{target}/stats.json")
+        self.name: str = ""
+        self.engine_id: Optional[str] = None
+        self.doc: Optional[dict] = None      # last good /stats.json
+        self.last_ok = 0.0
+        self.last_attempt = 0.0
+        self.last_error: str = ""
+        self.scrapes = 0
+        self.errors = 0
+        self.prev: Dict[str, float] = {}     # last absolute counter values
+
+    def status(self, now: float, stale_after: float) -> str:
+        """ONLINE while the last successful scrape is within the
+        staleness window — one transient scrape error must not flap the
+        fleet view.  Past the window: UNREACHABLE when the most recent
+        attempt failed (or nothing ever answered), STALE when scraping
+        itself went quiet (aggregator paused) with no error to show."""
+        if self.doc is None:
+            return STATUS_UNREACHABLE
+        if now - self.last_ok <= stale_after:
+            return STATUS_ONLINE
+        return STATUS_UNREACHABLE if self.last_error else STATUS_STALE
+
+
+def _http_fetch(url: str, timeout: float) -> dict:
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+class FleetAggregator:
+    """Scrapes N managers' /stats.json into one fleet view.
+
+    ``poll_once()`` is callable directly (tests and short campaigns
+    drive ticks by hand, like RegistrySampler); ``start()`` runs it from
+    a daemon thread.  ``fetch`` is injectable for hermetic tests."""
+
+    def __init__(self, targets: List[str], interval: float = 5.0,
+                 capacity: int = 240, timeout: float = 5.0,
+                 stale_after: float = 0.0,
+                 fetch: Optional[Callable[[str], dict]] = None):
+        self.interval = float(interval)
+        self.timeout = float(timeout)
+        # 3 missed scrape windows => stale (operator rule of thumb)
+        self.stale_after = float(stale_after) or 3.0 * max(
+            self.interval, 1.0)
+        self.engines = [_Engine(t) for t in targets]
+        self._fetch = fetch or (
+            lambda target: _http_fetch(
+                next(e.url for e in self.engines if e.target == target),
+                self.timeout))
+        self.store = TimeSeriesStore(capacity)
+        self.samples_taken = 0
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {}  # folded, monotonic
+        # merged-attribution sources, deduped: one local-process ledger
+        # per proc token, one state per engine id (latest wins)
+        self._local_ledgers: Dict[str, Dict] = {}
+        self._engine_ledgers: Dict[str, Dict] = {}
+        # proc token per engine-ledger key: one process has ONE global
+        # ledger, so engine entries sharing a proc (two fuzzers in one
+        # process, seen via one or two managers) collapse to one
+        self._engine_ledger_procs: Dict[str, str] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        reg = get_registry()
+        self._c_scrapes = reg.counter(
+            "fleet_scrapes_total",
+            help="manager /stats.json scrape attempts by the fleet "
+                 "aggregator")
+        self._c_scrape_errors = reg.counter(
+            "fleet_scrape_errors_total",
+            help="manager /stats.json scrapes that failed (the engine "
+                 "is marked stale/unreachable, never dropped)")
+        self._g_online = reg.gauge(
+            "fleet_engines_online",
+            help="scraped managers currently answering /stats.json "
+                 "within the staleness window")
+
+    # ---- polling ----
+
+    def poll_once(self, now: Optional[float] = None) -> int:
+        """Scrape every target once; returns how many answered.  Never
+        raises — a dead manager is a status, not an exception."""
+        now = time.time() if now is None else now
+        ok = 0
+        for eng in self.engines:
+            eng.last_attempt = now
+            self._c_scrapes.inc()
+            try:
+                doc = self._fetch(eng.target)
+                if not isinstance(doc, dict):
+                    raise ValueError("stats document is not an object")
+            except Exception as e:  # noqa: BLE001 — status, not crash
+                eng.errors += 1
+                eng.last_error = f"{type(e).__name__}: {e}"
+                self._c_scrape_errors.inc()
+                continue
+            ok += 1
+            eng.scrapes += 1
+            eng.doc = doc
+            eng.last_ok = now
+            eng.last_error = ""
+            eng.name = str(doc.get("name") or eng.target)
+            eng.engine_id = doc.get("engine_id") or eng.engine_id
+            with self._lock:
+                self._fold_counters_locked(eng)
+                self._merge_attribution_locked(doc)
+        with self._lock:
+            online = sum(1 for e in self.engines
+                         if e.status(now, self.stale_after)
+                         == STATUS_ONLINE)
+            self._g_online.set(online)
+            point = dict(self._counters)
+            point.update(self._gauge_sums_locked())
+            point["fleet_engines_online"] = online
+            self.store.record_snapshot(now, point)
+            self.samples_taken += 1
+        return ok
+
+    def _fold_counters_locked(self, eng: _Engine) -> None:
+        """Restart-aware delta fold of one engine's snapshot counters
+        into the fleet aggregate (the rate_points clamp: negative
+        deltas — a restarted engine whose --resume rewound its counters
+        to the last checkpoint — contribute 0 until the engine catches
+        back up past its previous high-water mark, keeping the fleet
+        totals monotonic without double-counting)."""
+        snap = (eng.doc or {}).get("snapshot") or {}
+        for k, v in snap.items():
+            if k in GAUGE_KEYS or not isinstance(v, (int, float)):
+                continue
+            prev = eng.prev.get(k, 0)
+            dv = v - prev
+            if dv > 0:
+                self._counters[k] = self._counters.get(k, 0) + dv
+            eng.prev[k] = max(v, prev)
+
+    def _gauge_sums_locked(self) -> Dict[str, float]:
+        """Sum-of-latest over every engine that ever answered: stale and
+        unreachable engines keep contributing their last-known values —
+        marked, not dropped."""
+        out: Dict[str, float] = {}
+        for eng in self.engines:
+            snap = (eng.doc or {}).get("snapshot") or {}
+            for k in GAUGE_KEYS:
+                v = snap.get(k)
+                if isinstance(v, (int, float)) and k != "phase":
+                    out[k] = out.get(k, 0) + v
+        return out
+
+    def _merge_attribution_locked(self, doc: dict) -> None:
+        st = doc.get("attribution_state")
+        if not isinstance(st, dict):
+            return
+        proc = st.get("proc")
+        if proc and isinstance(st.get("local"), dict):
+            # one process-global ledger per process, however many
+            # managers in that process serve it
+            self._local_ledgers[str(proc)] = st["local"]
+        for name, ent in (st.get("engines") or {}).items():
+            if not isinstance(ent, dict) or not ent.get("state"):
+                continue
+            # dedup remote engines by persistent id when stamped, else
+            # by manager-scoped name (pre-id engines can't be followed
+            # across managers — documented limitation)
+            key = str(ent.get("engine_id") or f"{doc.get('name')}:{name}")
+            eproc = str(ent.get("proc") or "")
+            if eproc:
+                # one surviving entry per engine PROCESS (its ledger is
+                # process-global): a second fuzzer of the same process,
+                # or the same engine seen through two managers, would
+                # otherwise double-count every cell
+                for other, op in list(self._engine_ledger_procs.items()):
+                    if op == eproc and other != key:
+                        self._engine_ledgers.pop(other, None)
+                        self._engine_ledger_procs.pop(other, None)
+                self._engine_ledger_procs[key] = eproc
+            self._engine_ledgers[key] = ent["state"]
+
+    # ---- reading ----
+
+    def merged_ledger(self) -> AttributionLedger:
+        """The exact fleet attribution ledger: every deduped source
+        merged once (merge_state is cell-wise integer addition, so the
+        merged phase totals equal the sum of the sources')."""
+        merged = AttributionLedger()
+        with self._lock:
+            # an engine entry whose proc also served a local ledger
+            # (an engine sharing a manager's process, scraped through a
+            # DIFFERENT manager) is the same ledger twice: local wins
+            sources = list(self._local_ledgers.values()) + [
+                st for key, st in self._engine_ledgers.items()
+                if self._engine_ledger_procs.get(key)
+                not in self._local_ledgers]
+        for st in sources:
+            merged.merge_state(st)
+        return merged
+
+    def engine_rows(self, now: Optional[float] = None
+                    ) -> List[Dict[str, object]]:
+        now = time.time() if now is None else now
+        rows = []
+        for eng in self.engines:
+            snap = (eng.doc or {}).get("snapshot") or {}
+            rows.append({
+                "target": eng.target,
+                "name": eng.name or eng.target,
+                "engine_id": eng.engine_id,
+                "status": eng.status(now, self.stale_after),
+                "last_ok_age_s": (round(now - eng.last_ok, 1)
+                                  if eng.last_ok else None),
+                "scrapes": eng.scrapes,
+                "errors": eng.errors,
+                "last_error": eng.last_error,
+                "engines": (eng.doc or {}).get("engines") or {},
+                "snapshot": snap,
+            })
+        return rows
+
+    def fleet_doc(self, now: Optional[float] = None) -> Dict[str, object]:
+        """The /fleet.json payload."""
+        now = time.time() if now is None else now
+        merged = self.merged_ledger()
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = self._gauge_sums_locked()
+            engine_ledgers = {k: dict(v)
+                              for k, v in self._engine_ledgers.items()}
+        rows = self.engine_rows(now)
+        return {
+            "schema_version": FLEET_SCHEMA_VERSION,
+            "now": now,
+            "interval": self.interval,
+            "samples": self.samples_taken,
+            "engines": rows,
+            "engines_online": sum(1 for r in rows
+                                  if r["status"] == STATUS_ONLINE),
+            "counters": counters,
+            "gauges": gauges,
+            "series": self.store.to_dict(),
+            "attribution": merged.snapshot(),
+            "attribution_state": merged.state(),
+            "engine_ledgers": engine_ledgers,
+        }
+
+    # ---- thread lifecycle (mirrors RegistrySampler) ----
+
+    def start(self) -> None:
+        if self.interval <= 0 or self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="fleet-aggregator", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2.0)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self.poll_once()
+            except Exception:
+                pass  # poll_once already never raises; belt and braces
+
+
+class FleetHttp:
+    """Serves /fleet.json + the /fleet dashboard for a FleetAggregator
+    (same shape as ManagerHttp: ephemeral-port friendly, daemon thread)."""
+
+    def __init__(self, fleet: FleetAggregator, host: str = "127.0.0.1",
+                 port: int = 0):
+        import http.server
+        import urllib.parse
+
+        self.fleet = fleet
+        ui = self
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self) -> None:
+                try:
+                    url = urllib.parse.urlparse(self.path)
+                    route = {
+                        "/": ui._dashboard,
+                        "/fleet": ui._dashboard,
+                        "/fleet.json": ui._fleet_json,
+                        "/metrics": ui._metrics,
+                    }.get(url.path)
+                    if route is None:
+                        self.send_error(404)
+                        return
+                    ctype, body = route()
+                    self.send_response(200)
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # pragma: no cover - defensive
+                    try:
+                        self.send_error(500, str(e))
+                    except Exception:
+                        pass
+
+        class _Server(http.server.ThreadingHTTPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = _Server((host, port), _Handler)
+        self.addr = "%s:%d" % self._server.server_address
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    # ---- endpoints ----
+
+    def _fleet_json(self) -> tuple:
+        return ("application/json",
+                json.dumps(self.fleet.fleet_doc(), sort_keys=True).encode())
+
+    def _metrics(self) -> tuple:
+        return ("text/plain; version=0.0.4",
+                get_registry().prometheus_text().encode())
+
+    def _dashboard(self) -> tuple:
+        """The fleet pane: aggregate sparklines (exec/signal/crash),
+        per-engine health + yield table, merged operator attribution —
+        same rendering idioms as the manager dashboard (values live in
+        text; strokes only say "this is the series")."""
+        from .html import _fmt_num, _page, _spark_panel, _table
+
+        doc = self.fleet.fleet_doc()
+        stored = doc["series"]
+        parts = ['<p><a href="/fleet.json">fleet.json</a></p>']
+
+        def series(name):
+            s = stored.get(name) or {"t": [], "v": []}
+            return s["t"], s["v"]
+
+        panels = []
+        for title, name, as_rate in (
+                ("fleet exec rate /s", "exec_total", True),
+                ("fleet signal", "signal", False),
+                ("fleet crash rate /s", "crashes", True),
+                ("fleet corpus", "corpus", False),
+                ("engines online", "fleet_engines_online", False)):
+            ts, vals = series(name)
+            if as_rate:
+                pts = rate_points(ts, vals)
+                ts = [t for t, _ in pts]
+                vals = [v for _, v in pts]
+            panels.append(_spark_panel(title, ts, vals))
+        parts.append('<div class="sparks">' + "".join(panels) + "</div>")
+
+        rows = []
+        for r in doc["engines"]:
+            snap = r["snapshot"]
+            execs = snap.get("exec_total", 0)
+            adds = snap.get("new_inputs", 0)
+            rows.append([
+                r["name"], r["target"], r["engine_id"] or "-",
+                r["status"],
+                "-" if r["last_ok_age_s"] is None
+                else f'{r["last_ok_age_s"]}s',
+                _fmt_num(snap.get("corpus", 0)),
+                _fmt_num(snap.get("signal", 0)),
+                _fmt_num(execs), _fmt_num(adds),
+                _fmt_num(round(1000.0 * adds / execs, 3)) if execs
+                else "n/a",
+                _fmt_num(snap.get("crashes", 0)),
+                r["errors"],
+            ])
+        parts.append(
+            "<h2>engines</h2>" + _table(
+                ["manager", "target", "engine id", "status", "last seen",
+                 "corpus", "signal", "execs", "new inputs",
+                 "yield/kexec", "crashes", "scrape errors"], rows))
+
+        att = doc["attribution"]
+        cols = ["execs", "corpus_adds", "new_signal", "adds_per_kexec",
+                "signal_per_kexec"]
+        ops = att.get("operators", {})
+        if ops:
+            orows = [[name] + [_fmt_num(c[k]) for k in cols]
+                     for name, c in sorted(
+                         ops.items(),
+                         key=lambda kv: -kv[1]["adds_per_kexec"])]
+            parts.append("<h2>merged per-operator yield</h2>"
+                         + _table(["operator"] + cols, orows))
+        phases = att.get("phases", {})
+        if phases:
+            prows = [[name] + [_fmt_num(c[k]) for k in cols]
+                     for name, c in sorted(phases.items())]
+            parts.append("<h2>merged per-phase yield</h2>"
+                         + _table(["phase"] + cols, prows))
+        fold = [[k, _fmt_num(v)]
+                for k, v in sorted(doc["counters"].items())][:40]
+        if fold:
+            parts.append("<h2>folded fleet counters (monotonic)</h2>"
+                         + _table(["counter", "value"], fold))
+        return "text/html", _page(
+            f"fleet ({doc['engines_online']}/{len(doc['engines'])} online)",
+            "".join(parts))
+
+
+def main(argv=None) -> int:
+    """``python -m syzkaller_tpu.manager.fleet`` — standalone fleet
+    aggregator over comma-separated manager HTTP addresses."""
+    import argparse
+
+    ap = argparse.ArgumentParser(prog="syz-fleet")
+    ap.add_argument("--managers", required=True,
+                    help="comma-separated manager HTTP addresses "
+                         "(host:port of the manager UI)")
+    ap.add_argument("--http", default="127.0.0.1:0",
+                    help="address to serve /fleet + /fleet.json on")
+    ap.add_argument("--interval", type=float, default=5.0)
+    args = ap.parse_args(argv)
+    targets = [t.strip() for t in args.managers.split(",") if t.strip()]
+    fleet = FleetAggregator(targets, interval=args.interval)
+    host, port = args.http.rsplit(":", 1)
+    http = FleetHttp(fleet, host, int(port))
+    http.start()
+    fleet.start()
+    print(f"fleet aggregator over {len(targets)} manager(s) "
+          f"on http://{http.addr}/fleet")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        fleet.stop()
+        http.stop()
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

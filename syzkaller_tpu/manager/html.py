@@ -23,6 +23,11 @@ import time
 from ..prog.encoding import call_set
 from ..telemetry import get_ledger, get_registry, get_tracer, rate_points
 
+# /stats.json wire-shape version: the fleet aggregator (manager/fleet.py)
+# and any external scraper key off this — bump it whenever a top-level
+# key is added/removed/retyped (tests/test_fleet.py pins the shape)
+STATS_SCHEMA_VERSION = 1
+
 _STYLE = """
 <style>
 body { font-family: monospace; margin: 1em 2em;
@@ -322,14 +327,28 @@ class ManagerHttp:
     def _stats_json(self, q) -> tuple:
         """Ring-buffer time series (registry snapshot sampled on the
         manager's analytics interval) + the phase/operator attribution
-        ledger + a point-in-time snapshot, as one JSON document."""
+        ledger + a point-in-time snapshot, as one JSON document.
+
+        The shape is versioned (``schema_version``) and pinned by a
+        regression test: the fleet aggregator and external scrapers
+        depend on it.  ``attribution_state`` carries the EXACT raw
+        ledger counts (local process once, remote engines latest-wins)
+        that merge across managers without double-counting;
+        ``attribution`` stays the derived human-facing snapshot."""
         sampler = getattr(self.mgr, "sampler", None)
+        att_state = getattr(self.mgr, "attribution_state", None)
+        engines = getattr(self.mgr, "engines_info", None)
         payload = {
+            "schema_version": STATS_SCHEMA_VERSION,
+            "engine_id": getattr(self.mgr, "engine_id", None),
+            "name": self.mgr.cfg.name,
             "now": time.time(),
             "interval": sampler.interval if sampler else None,
             "samples": sampler.samples_taken if sampler else 0,
             "series": sampler.store.to_dict() if sampler else {},
             "attribution": get_ledger().snapshot(),
+            "attribution_state": att_state() if att_state else None,
+            "engines": engines() if engines else {},
             "snapshot": self.mgr.snapshot(),
         }
         return ("application/json",
@@ -452,6 +471,7 @@ class ManagerHttp:
             "device_degraded_total", "drain_rows_dropped_total",
             "fleet_drain_rows_dropped",
             "checkpoint_age_seconds", "checkpoint_writes_total",
+            "journal_records_total", "journal_bytes_total",
             "errors_total") if k in snap]
         if sup:
             parts.append("<h2>supervision</h2>"

@@ -20,7 +20,7 @@ import threading
 import time
 from typing import Any, Dict, Optional
 
-from ..telemetry import count_error, get_registry
+from ..telemetry import count_error, get_registry, journal_emit
 from ..testing import faults as _faults
 from ..testing.faults import InjectedFault
 
@@ -217,6 +217,10 @@ class RemoteManager:
             pass
         self.client = client
         self._c_reconnects.inc()
+        # the campaign journal (when an engine in this process owns one)
+        # records the reconnect: RPC topology changes are exactly the
+        # cross-restart forensics the fleet story needs
+        journal_emit("rpc_reconnect", method=method, addr=self.addr)
         if method != "connect":
             try:
                 self.client.call("connect", name=self.name)
@@ -231,10 +235,16 @@ class RemoteManager:
                           prog_text=prog_text, call_index=call_index,
                           signal=list(signal), cover=list(cover))
 
-    def poll(self, stats, need_candidates: bool, new_signal=()):
+    def poll(self, stats, need_candidates: bool, new_signal=(),
+             ledger=None):
+        # the ledger kwarg is omitted when absent so poll handlers that
+        # predate it keep accepting DIRECT RemoteManager.poll() callers
+        # (test stubs, tooling); the engine itself always ships a
+        # ledger, so a same-repo manager is required on that path
+        kw = {"ledger": ledger} if ledger is not None else {}
         return self._call("poll", name=self.name, stats=stats,
                           need_candidates=need_candidates,
-                          new_signal=list(new_signal))
+                          new_signal=list(new_signal), **kw)
 
     def close(self) -> None:
         self.client.close()

@@ -26,6 +26,13 @@ from .attribution import (  # noqa: F401
     get_ledger,
     ops_from_mask,
 )
+from .journal import (  # noqa: F401
+    PROC_TOKEN,
+    CampaignJournal,
+    get_journal,
+    journal_emit,
+    mint_engine_id,
+)
 from .metrics import (  # noqa: F401
     DEFAULT_BUCKETS,
     Counter,

@@ -168,10 +168,12 @@ class AttributionLedger:
             }
 
     def load_state(self, st: Dict[str, Dict]) -> None:
-        """Replace the ledger wholesale from a checkpointed ``state()``.
-        NOTE: the ledger is process-global — restoring overwrites any
-        credit other in-process fuzzers accumulated (cross-restart
-        continuity is an open ROADMAP item)."""
+        """Replace the ledger wholesale from a checkpointed ``state()``
+        — the ``--resume`` path: a fresh process restoring its own
+        trajectory (the persistent engine_id keeps it the SAME
+        trajectory across the restart; the restored counts continue
+        monotonically from the checkpoint).  For folding ledgers from
+        several engines, use ``merge_state``."""
         with self._lock:
             self._phases.clear()
             self._ops.clear()
@@ -183,6 +185,29 @@ class AttributionLedger:
                 c = self._op(int(o))
                 c.execs, c.new_signal, c.corpus_adds = \
                     int(e), int(ns), int(ca)
+
+    def merge_state(self, st: Dict[str, Dict]) -> None:
+        """Fold another ledger's raw ``state()`` INTO this one (counts
+        add cell-wise) — the cross-engine aggregation edge: ledgers
+        from N engines merged into one fleet ledger are EXACT, because
+        every cell is an integer event count credited by exactly one
+        engine (merged phase totals == sum of the engines' phase
+        totals; the tests pin merged corpus_adds-minus-seed == sum of
+        engines' new_inputs).  Merging the same engine's state twice
+        double-counts by construction — callers dedup by engine_id
+        (manager/fleet.py) and keep only the latest absolute state per
+        engine."""
+        with self._lock:
+            for p, (e, ns, ca) in (st.get("phases") or {}).items():
+                c = self._phase(p)
+                c.execs += int(e)
+                c.new_signal += int(ns)
+                c.corpus_adds += int(ca)
+            for o, (e, ns, ca) in (st.get("ops") or {}).items():
+                c = self._op(int(o))
+                c.execs += int(e)
+                c.new_signal += int(ns)
+                c.corpus_adds += int(ca)
 
 
 class Provenance:

@@ -1,0 +1,489 @@
+"""Durable campaign journal: the workdir outlives the process.
+
+The telemetry spine (registry, sampler series, attribution ledger) dies
+with the engine; the checkpoint restores the *latest* state but not the
+*trajectory*.  The journal closes that gap: an append-only, CRC-chained,
+sequence-numbered JSONL event log in the workdir, written at every state
+transition the registry only counts — checkpoint save/restore, env
+restart/quarantine/unquarantine, device degradation-ladder steps,
+admission Bloom resets + yield decays, RPC reconnects, and crash /
+corpus-add / new-signal events stamped with full provenance (phase,
+operator indices, arena row) — so a campaign's corpus/signal/yield
+trajectory can be rebuilt from the workdir alone (``replay``), no live
+process required.
+
+Record format (one JSON object per line, key order canonicalized):
+
+    {"seq": N, "t": <unix ts>, "ev": "<type>", "eng": "<engine id>",
+     "pc": "<prev record's crc>", ...event fields..., "crc": "<crc32>"}
+
+``crc`` is the CRC32 (hex) of the record's canonical JSON *without* the
+crc field; ``pc`` chains it to the previous record, so a reader verifies
+both per-record integrity and the end-to-end chain (``verify_records``).
+Rotation keeps the log bounded: past ``max_bytes`` the current segment
+shifts to ``<path>.1`` (older segments to ``.2``...), the oldest beyond
+``segments`` is dropped, and ``seq``/``pc`` continue across the shift —
+a rotated-away prefix breaks only the first surviving record's back
+link, which the verifier reports as informational, not corruption.
+
+Durability bound: every ``emit`` writes one complete line and flushes it
+to the OS, so a SIGKILL'd engine loses at most the record being written
+at the instant of the kill (a truncated final line, which readers
+tolerate and count as a defect).  ``sync()`` additionally fsyncs — the
+engine calls it on every checkpoint and on clean exit, where the
+terminal ``campaign_end`` record is written.
+
+Like the rest of telemetry: stdlib only, no jax/numpy — the journal
+must load (and replay) on host-only deployments and in offline tooling
+(tools/journalcat.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import zlib
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from .metrics import get_registry
+
+JOURNAL_NAME = "journal.jsonl"
+DEFAULT_MAX_BYTES = 4 << 20
+DEFAULT_SEGMENTS = 4
+
+# One random token per *process*, minted at import: lets a manager tell
+# "this engine shares my process" (its ledger credit is already in the
+# process-global ledger — merging its shipped state would double-count)
+# from a genuinely remote engine.  Not persisted on purpose: a restart
+# IS a new process.
+PROC_TOKEN = os.urandom(8).hex()
+
+
+def mint_engine_id(workdir: str = "") -> str:
+    """The persistent engine identity: minted once per workdir (stored
+    in ``<workdir>/engine_id`` so ``--resume`` and every later restart
+    continue the same trajectory under the same id), ephemeral when no
+    workdir is configured.  Stamped into wire stats, /stats.json,
+    journal records, and checkpoints — the key fleet tooling dedups and
+    attributes by."""
+    fresh = "eng-" + os.urandom(8).hex()
+    if not workdir:
+        return fresh
+    path = os.path.join(workdir, "engine_id")
+    try:
+        os.makedirs(workdir, exist_ok=True)
+        with open(path, "r", encoding="utf-8") as fh:
+            got = fh.read().strip()
+        if got:
+            return got
+    except OSError:
+        pass
+    try:
+        # atomic claim: two racing processes in one workdir both end up
+        # reading the same winner
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            fh.write(fresh + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+        if os.path.exists(path):
+            os.remove(tmp)
+            with open(path, "r", encoding="utf-8") as fh:
+                return fh.read().strip() or fresh
+        os.replace(tmp, path)
+    except OSError:
+        return fresh  # unwritable workdir: stay ephemeral, never raise
+    return fresh
+
+
+def _canon(rec: Dict) -> bytes:
+    return json.dumps(rec, sort_keys=True, separators=(",", ":")).encode()
+
+
+def _crc(rec: Dict) -> str:
+    return "%08x" % zlib.crc32(_canon(rec))
+
+
+class CampaignJournal:
+    """Append-only rotating JSONL writer with a CRC/seq chain.
+
+    Thread-safe: drain workers, the supervisor, and the scheduling
+    thread all emit.  IO failures are counted (``errors_journal_write``)
+    and swallowed — a full disk must not kill the campaign the journal
+    exists to make auditable."""
+
+    def __init__(self, path: str, engine_id: str = "",
+                 max_bytes: int = DEFAULT_MAX_BYTES,
+                 segments: int = DEFAULT_SEGMENTS):
+        self.path = path
+        self.engine_id = engine_id
+        self.max_bytes = max(int(max_bytes), 1 << 12)
+        self.segments = max(int(segments), 1)
+        self._lock = threading.Lock()
+        self._fh = None
+        self._size = 0
+        self.records_written = 0
+        reg = get_registry()
+        self._c_records = reg.counter(
+            "journal_records_total",
+            help="campaign journal records appended (event-sourced "
+                 "state transitions: checkpoints, env supervision, "
+                 "degradation, admission resets, corpus adds)")
+        self._c_bytes = reg.counter(
+            "journal_bytes_total",
+            help="campaign journal bytes appended (pre-rotation; the "
+                 "on-disk footprint is bounded by max_bytes * segments)")
+        self._c_rotations = reg.counter(
+            "journal_rotations_total",
+            help="campaign journal segment rotations (oldest segment "
+                 "beyond the retention bound is dropped)")
+        # continue an existing journal's chain (resume in the same
+        # workdir): the next record's seq/pc pick up where the last
+        # durable record left off
+        self.seq, self.prev_crc = self._recover_tail()
+
+    # ---- writing ----
+
+    def emit(self, ev: str, **fields) -> Optional[Dict]:
+        """Append one event record; returns the record (or None when the
+        write failed and was counted)."""
+        import time
+
+        rec = dict(fields)
+        rec["ev"] = ev
+        rec["t"] = round(time.time(), 3)
+        if self.engine_id:
+            rec["eng"] = self.engine_id
+        with self._lock:
+            rec["seq"] = self.seq
+            rec["pc"] = self.prev_crc
+            rec["crc"] = _crc(rec)
+            line = json.dumps(rec, sort_keys=True,
+                              separators=(",", ":")) + "\n"
+            try:
+                self._write_locked(line)
+            except Exception as e:
+                self._count_write_error(e)
+                return None
+            self.seq += 1
+            self.prev_crc = rec["crc"]
+            self.records_written += 1
+        self._c_records.inc()
+        self._c_bytes.inc(len(line))
+        return rec
+
+    def _write_locked(self, line: str) -> None:
+        if self._fh is None:
+            self._fh = open(self.path, "a", encoding="utf-8")
+            self._size = self._fh.tell()
+        self._fh.write(line)
+        # flush to the OS per record: SIGKILL then loses at most the
+        # line being written this very instant (the durability bound
+        # the chaos test pins); fsync is reserved for sync()
+        self._fh.flush()
+        self._size += len(line)
+        if self._size >= self.max_bytes:
+            self._rotate_locked()
+
+    def _rotate_locked(self) -> None:
+        self._fh.close()
+        self._fh = None
+        oldest = f"{self.path}.{self.segments - 1}"
+        if os.path.exists(oldest):
+            os.remove(oldest)
+        for k in range(self.segments - 2, 0, -1):
+            src = f"{self.path}.{k}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{k + 1}")
+        if self.segments > 1:
+            os.replace(self.path, f"{self.path}.1")
+        else:
+            os.remove(self.path)  # retention of one: truncate in place
+        self._size = 0
+        self._c_rotations.inc()
+
+    def sync(self) -> None:
+        """Flush + fsync the current segment (checkpoint / clean-exit
+        durability; per-record emits only flush to the OS)."""
+        with self._lock:
+            if self._fh is None:
+                return
+            try:
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+            except Exception as e:
+                self._count_write_error(e)
+
+    def close(self) -> None:
+        with self._lock:
+            fh, self._fh = self._fh, None
+            if fh is None:
+                return
+            try:
+                fh.flush()
+                os.fsync(fh.fileno())
+            except Exception as e:
+                self._count_write_error(e)
+            finally:
+                fh.close()
+
+    def _count_write_error(self, e: Exception) -> None:
+        # local import: telemetry/__init__ imports this module
+        from . import count_error
+
+        count_error("journal_write", e)
+
+    def _recover_tail(self) -> Tuple[int, str]:
+        """Last durable (seq, crc) across existing segments, so a resumed
+        engine continues the chain instead of restarting seq at 0.  A
+        partial trailing record (the SIGKILL artifact) is truncated away
+        first: appending onto it would fuse two records into one
+        undecodable mid-file line — turning the tolerated ``tail:``
+        defect into permanent corruption AND losing the first
+        post-restart record."""
+        try:
+            self._heal_partial_tail()
+            records, _defects = read_records(self.path)
+        except OSError:
+            return 0, ""
+        if not records:
+            return 0, ""
+        last = records[-1]
+        return int(last.get("seq", -1)) + 1, str(last.get("crc", ""))
+
+    def _heal_partial_tail(self) -> None:
+        """Drop undecodable trailing line(s) from the CURRENT segment (the
+        only one ever appended to).  Every complete record ends with a
+        newline and decodes as a JSON object; a crash mid-write leaves at
+        most one trailing line violating that.  Earlier (non-trailing)
+        corruption is evidence and is left untouched."""
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "rb") as fh:
+            data = fh.read()
+        # line offsets: (start, end incl. newline, newline-terminated)
+        lines = []
+        pos = 0
+        while pos < len(data):
+            nl = data.find(b"\n", pos)
+            if nl < 0:
+                lines.append((pos, len(data), False))
+                break
+            lines.append((pos, nl + 1, True))
+            pos = nl + 1
+        keep = len(data)
+        for start, end, terminated in reversed(lines):
+            chunk = data[start:end].strip()
+            good = False
+            if terminated and chunk:
+                try:
+                    good = isinstance(json.loads(chunk), dict)
+                except ValueError:
+                    good = False
+            if good:
+                break  # durable tail found: keep everything up to here
+            keep = start
+        if keep < len(data):
+            with open(self.path, "rb+") as fh:
+                fh.truncate(keep)
+
+
+# ---- process-global hook (far call sites: rpc reconnects, manager
+# crash persistence — the engine owns and installs its journal) ----
+
+_active: Optional[CampaignJournal] = None
+
+
+def install(journal: Optional[CampaignJournal]) -> None:
+    global _active
+    _active = journal
+
+
+def get_journal() -> Optional[CampaignJournal]:
+    return _active
+
+
+def journal_emit(ev: str, **fields) -> None:
+    """Emit on the installed journal; no-op (one global read) when no
+    campaign journal is active — production call sites outside the
+    engine pay nothing in journal-less deployments."""
+    j = _active
+    if j is not None:
+        j.emit(ev, **fields)
+
+
+# ---- reading / verification ----
+
+
+def journal_segments(path: str) -> List[str]:
+    """Existing segment files oldest-first (``path.N`` ... ``path.1``,
+    then ``path``).  ``path`` may be the journal file or a workdir
+    containing ``journal.jsonl``."""
+    if os.path.isdir(path):
+        path = os.path.join(path, JOURNAL_NAME)
+    out = []
+    k = 1
+    while os.path.exists(f"{path}.{k}"):
+        k += 1
+    for i in range(k - 1, 0, -1):
+        out.append(f"{path}.{i}")
+    if os.path.exists(path):
+        out.append(path)
+    return out
+
+
+def read_records(path: str) -> Tuple[List[Dict], List[str]]:
+    """Decode every record across segments oldest-first; returns
+    (records, defects).  A truncated or corrupt line is a defect string,
+    never an exception — the journal's contract is that a SIGKILL
+    leaves at most one partial trailing record, and readers keep
+    everything before it.  A defect that IS that tolerated case (the
+    final line of the newest segment) is prefixed ``tail:`` so
+    verifiers can report it as the expected crash artifact rather than
+    corruption."""
+    records: List[Dict] = []
+    defects: List[str] = []
+    segs = journal_segments(path)
+    for si, seg in enumerate(segs):
+        with open(seg, "rb") as fh:
+            data = fh.read()
+        lines = [(i, raw) for i, raw in enumerate(data.splitlines())
+                 if raw.strip()]
+        for li, (i, raw) in enumerate(lines):
+            try:
+                rec = json.loads(raw)
+                if not isinstance(rec, dict):
+                    raise ValueError("record is not an object")
+            except ValueError as e:
+                tail = (si == len(segs) - 1 and li == len(lines) - 1)
+                defects.append(
+                    f"{'tail: ' if tail else ''}"
+                    f"{os.path.basename(seg)}:{i + 1}: "
+                    f"undecodable record: {e}")
+                continue
+            records.append(rec)
+    return records, defects
+
+
+def verify_records(records: Iterable[Dict]) -> List[str]:
+    """CRC + seq/chain verification over decoded records (assumed
+    oldest-first).  Returns problem strings; empty == the chain holds
+    end-to-end.  The first record's back link is only checkable when it
+    is seq 0 (rotation may have dropped the true head)."""
+    problems: List[str] = []
+    prev_crc: Optional[str] = None
+    prev_seq: Optional[int] = None
+    for rec in records:
+        seq = rec.get("seq")
+        body = {k: v for k, v in rec.items() if k != "crc"}
+        want = _crc(body)
+        if rec.get("crc") != want:
+            problems.append(f"seq {seq}: crc mismatch "
+                            f"({rec.get('crc')!r} != {want})")
+            # a corrupt record breaks the chain; re-anchor on it so one
+            # flip reports once, not for every successor
+        if prev_seq is not None and seq != prev_seq + 1:
+            problems.append(f"seq {seq}: gap after {prev_seq}")
+        if prev_crc is not None and rec.get("pc") != prev_crc:
+            problems.append(f"seq {seq}: chain break (pc "
+                            f"{rec.get('pc')!r} != prev crc {prev_crc!r})")
+        elif prev_crc is None and seq == 0 and rec.get("pc") != "":
+            problems.append("seq 0: nonempty back link on the first "
+                            "record")
+        prev_crc = rec.get("crc")
+        prev_seq = seq if isinstance(seq, int) else prev_seq
+    return problems
+
+
+def verify(path: str) -> List[str]:
+    """End-to-end journal verification: decode defects + chain problems
+    in one list (what ``journalcat --verify`` prints)."""
+    records, defects = read_records(path)
+    return defects + verify_records(records)
+
+
+# ---- replay: the trajectory from the workdir alone ----
+
+
+def replay(path: str) -> Dict:
+    """Rebuild the campaign's corpus/signal/yield trajectory from the
+    journal alone — no live process, no registry.  Event-sourced
+    counters are bit-exact (each ``corpus_add`` / ``signal`` record IS
+    the increment); exec totals ride the periodic ``checkpoint_save``
+    stats and are checkpoint-granular by design (per-exec journaling
+    would blow the telemetry overhead bound).
+
+    Returns::
+
+        {"records": N, "defects": [...], "engines": [ids...],
+         "events": {ev: count},
+         "corpus_total": adds incl. seed,
+         "new_inputs_total": adds excl. seed,
+         "signal_total": new-signal PCs accepted,
+         "series": {"corpus": [(t, v)], "new_inputs": [(t, v)],
+                    "signal": [(t, v)], "execs": [(t, v)]},
+         "attribution": {"phases": {p: {"corpus_adds", "new_signal"}},
+                         "operators": {op: {...}}},
+         "restores": checkpoint restores seen}
+    """
+    from .attribution import OP_NAMES
+
+    records, defects = read_records(path)
+    events: Dict[str, int] = {}
+    engines: List[str] = []
+    corpus = new_inputs = signal = restores = 0
+    series: Dict[str, List[Tuple[float, float]]] = {
+        "corpus": [], "new_inputs": [], "signal": [], "execs": []}
+    phases: Dict[str, Dict[str, int]] = {}
+    operators: Dict[str, Dict[str, int]] = {}
+
+    def cell(table, key):
+        c = table.get(key)
+        if c is None:
+            c = table[key] = {"corpus_adds": 0, "new_signal": 0}
+        return c
+
+    for rec in records:
+        ev = rec.get("ev", "?")
+        events[ev] = events.get(ev, 0) + 1
+        eng = rec.get("eng")
+        if eng and eng not in engines:
+            engines.append(eng)
+        t = float(rec.get("t", 0.0))
+        if ev == "corpus_add":
+            corpus += 1
+            phase = rec.get("phase", "?")
+            cell(phases, phase)["corpus_adds"] += 1
+            for op in rec.get("ops", ()):
+                if 0 <= int(op) < len(OP_NAMES):
+                    cell(operators, OP_NAMES[int(op)])["corpus_adds"] += 1
+            if phase != "seed":
+                new_inputs += 1
+                series["new_inputs"].append((t, new_inputs))
+            series["corpus"].append((t, corpus))
+        elif ev == "signal":
+            n = int(rec.get("n", 0))
+            signal += n
+            cell(phases, rec.get("phase", "?"))["new_signal"] += n
+            for op in rec.get("ops", ()):
+                if 0 <= int(op) < len(OP_NAMES):
+                    cell(operators, OP_NAMES[int(op)])["new_signal"] += n
+            series["signal"].append((t, signal))
+        elif ev in ("checkpoint_save", "campaign_end"):
+            if "execs" in rec:
+                series["execs"].append((t, int(rec["execs"])))
+        elif ev == "checkpoint_restore":
+            restores += 1
+    return {
+        "records": len(records),
+        "defects": defects + verify_records(records),
+        "engines": engines,
+        "events": events,
+        "corpus_total": corpus,
+        "new_inputs_total": new_inputs,
+        "signal_total": signal,
+        "series": series,
+        "attribution": {"phases": phases, "operators": operators},
+        "restores": restores,
+    }

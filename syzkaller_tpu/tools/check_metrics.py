@@ -81,6 +81,14 @@ REQUIRED_METRICS = (
     "rpc_retries_total",
     "device_degraded_total",
     "errors_total",
+    # fleet observability (ISSUE 7): the durable campaign journal's
+    # volume must stay visible (record/byte growth is the replay-cost
+    # axis), and the fleet aggregator's scrape health must never go
+    # silent — a fleet that can't see its engines isn't a fleet
+    "journal_records_total",
+    "journal_bytes_total",
+    "fleet_scrape_errors_total",
+    "fleet_engines_online",
 )
 
 

@@ -1,0 +1,102 @@
+"""journalcat: decode, filter, and verify a campaign journal.
+
+The offline half of the campaign journal (telemetry/journal.py): given a
+workdir (or the journal file itself), decode the JSONL records across
+rotated segments, verify the CRC/seq chain end-to-end, and print the
+records that match the filters — the campaign-forensics tool that
+answers "which operator/row/env produced each finding" without a live
+process.
+
+    python -m syzkaller_tpu.tools.journalcat <workdir>
+    python -m syzkaller_tpu.tools.journalcat <workdir> --type corpus_add
+    python -m syzkaller_tpu.tools.journalcat <workdir> --env 2
+    python -m syzkaller_tpu.tools.journalcat <workdir> --phase mutate
+    python -m syzkaller_tpu.tools.journalcat <workdir> --verify
+    python -m syzkaller_tpu.tools.journalcat <workdir> --replay
+
+Default mode prints matching records one JSON object per line (stdout)
+and chain problems to stderr; ``--verify`` prints only the verification
+verdict; ``--replay`` prints the replayed trajectory summary (the
+``telemetry.journal.replay`` document).  Exit code 1 when the chain has
+defects beyond the tolerated trailing truncation, 2 on usage errors.
+
+Wired into the test suite (tests/test_tools.py) like check_metrics, so
+the tool keeps decoding what the engine keeps writing.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List
+
+from ..telemetry import journal as _journal
+
+
+def _matches(rec: dict, types: List[str], env: int, phase: str) -> bool:
+    if types and rec.get("ev") not in types:
+        return False
+    if env >= 0 and rec.get("env") != env:
+        return False
+    if phase and rec.get("phase") != phase:
+        return False
+    return True
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="journalcat")
+    ap.add_argument("path",
+                    help="campaign workdir or journal.jsonl path")
+    ap.add_argument("--type", default="",
+                    help="comma-separated event types to keep "
+                         "(e.g. corpus_add,env_restart)")
+    ap.add_argument("--env", type=int, default=-1,
+                    help="keep only events of this executor env index")
+    ap.add_argument("--phase", default="",
+                    help="keep only events of this attribution phase")
+    ap.add_argument("--verify", action="store_true",
+                    help="verify the CRC/seq chain only (no record dump)")
+    ap.add_argument("--replay", action="store_true",
+                    help="print the replayed trajectory summary instead "
+                         "of raw records")
+    args = ap.parse_args(argv)
+
+    segments = _journal.journal_segments(args.path)
+    if not segments:
+        print(f"journalcat: no journal at {args.path!r}", file=sys.stderr)
+        return 2
+
+    records, defects = _journal.read_records(args.path)
+    # a truncated FINAL record is the journal's documented SIGKILL
+    # artifact (the at-most-one-lost-record durability bound) — report
+    # it, but don't fail the verification on it
+    tolerated = [d for d in defects if d.startswith("tail: ")]
+    problems = [d for d in defects if not d.startswith("tail: ")] \
+        + _journal.verify_records(records)
+
+    if args.replay:
+        doc = _journal.replay(args.path)
+        print(json.dumps(doc, sort_keys=True))
+    elif args.verify:
+        print(f"journalcat: {len(records)} record(s) across "
+              f"{len(segments)} segment(s), {len(problems)} problem(s)")
+    else:
+        types = [t for t in args.type.split(",") if t]
+        shown = 0
+        for rec in records:
+            if _matches(rec, types, args.env, args.phase):
+                print(json.dumps(rec, sort_keys=True))
+                shown += 1
+        print(f"journalcat: {shown}/{len(records)} record(s) shown, "
+              f"{len(problems)} chain problem(s)", file=sys.stderr)
+    for p in tolerated:
+        print(f"journalcat: tolerated crash artifact: {p}",
+              file=sys.stderr)
+    for p in problems:
+        print(f"journalcat: {p}", file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

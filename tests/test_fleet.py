@@ -1,0 +1,517 @@
+"""Fleet observability tests (ISSUE 7): the pinned /stats.json wire
+shape (schema_version + engine_id — the fleet aggregator and external
+scrapers depend on it), AttributionLedger.merge_state exactness (merge
+of N disjoint ledgers == campaign totals; restart continuation stays
+monotonic), the fleet aggregator's restart-aware counter folding and
+stale/unreachable marking, the /fleet.json + /fleet endpoints, and the
+two-engine chaos acceptance: one engine SIGKILL'd and ``--resume``d
+mid-campaign with /fleet.json aggregates monotonic across the restart
+and the merged ledger exactly equal to the sum of the engines' totals."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from syzkaller_tpu.manager.fleet import (
+    FleetAggregator,
+    FleetHttp,
+    STATUS_ONLINE,
+    STATUS_STALE,
+    STATUS_UNREACHABLE,
+)
+from syzkaller_tpu.prog import get_target
+from syzkaller_tpu.telemetry import AttributionLedger, get_registry
+
+
+@pytest.fixture(scope="module")
+def target():
+    return get_target("linux", "amd64")
+
+
+def _get_json(addr: str, path: str) -> dict:
+    with urllib.request.urlopen(f"http://{addr}{path}", timeout=10) as r:
+        return json.loads(r.read())
+
+
+# ---- /stats.json wire shape (satellite: pinned regression test) ----
+
+
+def test_stats_json_schema_pinned(tmp_path, target):
+    """The EXACT top-level shape external scrapers (and manager/fleet.py)
+    parse.  Adding/removing a key must bump STATS_SCHEMA_VERSION and
+    update this test deliberately."""
+    from syzkaller_tpu.manager import Manager, ManagerConfig
+    from syzkaller_tpu.manager.html import STATS_SCHEMA_VERSION
+
+    m = Manager(ManagerConfig(workdir=str(tmp_path),
+                              analytics_interval=0), target=target)
+    try:
+        doc = _get_json(m.http.addr, "/stats.json")
+    finally:
+        m.close()
+    assert set(doc) == {
+        "schema_version", "engine_id", "name", "now", "interval",
+        "samples", "series", "attribution", "attribution_state",
+        "engines", "snapshot"}
+    assert doc["schema_version"] == STATS_SCHEMA_VERSION == 1
+    # the manager's identity is the workdir-minted persistent id
+    assert doc["engine_id"] == \
+        (tmp_path / "engine_id").read_text().strip()
+    assert doc["name"] == m.cfg.name
+    ast = doc["attribution_state"]
+    assert set(ast) == {"proc", "local", "engines"}
+    assert set(ast["local"]) == {"phases", "ops"}
+
+
+def test_engine_id_rides_wire_stats_and_checkpoint(tmp_path, target):
+    """The engine stamps its persistent id into the wire stats (the
+    manager pops + records it) and its checkpoint."""
+    from syzkaller_tpu.engine import checkpoint as ckpt
+    from syzkaller_tpu.engine.fuzzer import Fuzzer, FuzzerConfig
+    from syzkaller_tpu.manager import Manager, ManagerConfig
+    from syzkaller_tpu.manager.rpc import RemoteManager
+
+    m = Manager(ManagerConfig(workdir=str(tmp_path / "mgr"),
+                              analytics_interval=0), target=target)
+    try:
+        rm = RemoteManager(m.rpc.addr, name="f0")
+        cfg = FuzzerConfig(mock=True, use_device=False,
+                           smash_mutations=1,
+                           workdir=str(tmp_path / "eng"),
+                           checkpoint_interval=0)
+        with Fuzzer(target, cfg, manager=rm) as f:
+            f.loop(iterations=30)
+            f.poll_manager()
+            f.save_checkpoint()
+            eid = f.engine_id
+        assert eid == (tmp_path / "eng" / "engine_id").read_text().strip()
+        doc = _get_json(m.http.addr, "/stats.json")
+        assert doc["engines"]["f0"]["engine_id"] == eid
+        # the numeric fold never saw the string id
+        assert "engine_id" not in doc["snapshot"]
+        st = ckpt.read_checkpoint(str(tmp_path / "eng" / "engine.ckpt"))
+        assert st["engine_id"] == eid
+    finally:
+        m.close()
+
+
+# ---- ledger merge exactness ----
+
+
+def test_merge_state_of_disjoint_ledgers_is_exact():
+    """Merge of N disjoint ledgers == the campaign totals, cell-exact."""
+    parts = []
+    for k in range(4):
+        led = AttributionLedger()
+        led.record_exec("mutate", (k % 5,), n=10 * (k + 1))
+        led.record_new_signal("mutate", (k % 5,), 3 * (k + 1))
+        for _ in range(k + 1):
+            led.record_corpus_add("mutate", (k % 5,))
+        led.record_exec("generate", n=k)
+        parts.append(led)
+    merged = AttributionLedger()
+    for led in parts:
+        merged.merge_state(led.state())
+    want = {
+        "execs": sum(led.totals()["execs"] for led in parts),
+        "new_signal": sum(led.totals()["new_signal"] for led in parts),
+        "corpus_adds": sum(led.totals()["corpus_adds"] for led in parts),
+    }
+    assert merged.totals() == want
+    # per-cell exactness, not just totals
+    snap = merged.snapshot()
+    assert snap["phases"]["mutate"]["execs"] == sum(
+        led.snapshot()["phases"]["mutate"]["execs"] for led in parts)
+    for k in range(4):
+        op = merged.snapshot()["operators"]
+        assert op  # operator rows survived the merge
+
+
+def test_merge_state_json_roundtrip_key_types():
+    """Ledger states cross the RPC wire as JSON, which stringifies the
+    integer operator keys — merge_state must fold them back."""
+    led = AttributionLedger()
+    led.record_exec("mutate", (0, 2), n=7)
+    led.record_corpus_add("mutate", (2,))
+    wire = json.loads(json.dumps(led.state()))
+    merged = AttributionLedger()
+    merged.merge_state(wire)
+    assert merged.state() == led.state()
+
+
+def test_load_state_restart_continuation_is_monotonic():
+    """--resume semantics: a ledger restored from a checkpoint and then
+    credited further never goes below the checkpointed counts."""
+    led = AttributionLedger()
+    led.record_exec("mutate", (1,), n=100)
+    led.record_corpus_add("mutate", (1,))
+    ckpt = led.state()
+    restored = AttributionLedger()
+    restored.load_state(json.loads(json.dumps(ckpt)))
+    assert restored.totals() == led.totals()
+    restored.record_exec("mutate", (1,), n=5)
+    restored.record_corpus_add("mutate", (1,))
+    after = restored.state()
+    for table in ("phases", "ops"):
+        for key, cell in ckpt[table].items():
+            got = after[table][type(list(after[table])[0])(key)] \
+                if after[table] else None
+            assert got is not None
+            assert all(b >= a for a, b in zip(cell, got))
+
+
+# ---- fleet aggregator folding / health ----
+
+
+def _doc(name, snapshot, engine_id="eng-x", att=None):
+    return {
+        "schema_version": 1, "engine_id": engine_id, "name": name,
+        "now": time.time(), "interval": 0, "samples": 1, "series": {},
+        "attribution": {}, "attribution_state": att,
+        "engines": {}, "snapshot": snapshot,
+    }
+
+
+def test_fleet_fold_is_monotonic_across_engine_restart():
+    """The rate_points clamp on the fold: a counter that went backwards
+    (engine restarted, --resume rewound to the checkpoint) contributes
+    nothing until it passes its high-water mark — the fleet aggregate
+    never decreases and never double-counts the replayed range."""
+    feed = {"m": {"exec_total": 100, "corpus": 5}}
+
+    fleet = FleetAggregator(["m"], interval=0,
+                            fetch=lambda t: _doc("m", feed[t]))
+    fleet.poll_once(now=1.0)
+    assert fleet.fleet_doc(now=1.0)["counters"]["exec_total"] == 100
+    feed["m"] = {"exec_total": 40, "corpus": 3}   # restart: rewound
+    fleet.poll_once(now=2.0)
+    assert fleet.fleet_doc(now=2.0)["counters"]["exec_total"] == 100
+    feed["m"] = {"exec_total": 90, "corpus": 4}   # catching up
+    fleet.poll_once(now=3.0)
+    assert fleet.fleet_doc(now=3.0)["counters"]["exec_total"] == 100
+    feed["m"] = {"exec_total": 130, "corpus": 6}  # past the mark
+    fleet.poll_once(now=4.0)
+    doc = fleet.fleet_doc(now=4.0)
+    assert doc["counters"]["exec_total"] == 130
+    # gauges are sum-of-latest, not folded (corpus tracked the rewind)
+    assert doc["gauges"]["corpus"] == 6
+    # the aggregate series stayed monotonic throughout
+    vals = doc["series"]["exec_total"]["v"]
+    assert vals == sorted(vals)
+
+
+def test_fleet_marks_unreachable_engines_without_dropping_them():
+    calls = {"n": 0}
+
+    def fetch(t):
+        if t == "dead" or (t == "flaky" and calls["n"] > 0):
+            raise OSError("connection refused")
+        calls["n"] += 1
+        return _doc("flaky", {"exec_total": 50, "corpus": 7, "signal": 9})
+
+    reg = get_registry()
+    before = reg.snapshot().get("fleet_scrape_errors_total", 0)
+    fleet = FleetAggregator(["flaky", "dead"], interval=0, fetch=fetch)
+    fleet.poll_once(now=1.0)
+    rows = {r["target"]: r for r in fleet.fleet_doc(now=1.0)["engines"]}
+    assert rows["flaky"]["status"] == STATUS_ONLINE
+    assert rows["dead"]["status"] == STATUS_UNREACHABLE  # never answered
+    fleet.poll_once(now=2.0)  # flaky has ONE transient failure
+    rows = {r["target"]: r for r in fleet.fleet_doc(now=2.0)["engines"]}
+    # grace window: a single blip inside stale_after must not flap the
+    # fleet view to unreachable
+    assert rows["flaky"]["status"] == STATUS_ONLINE
+    # past the staleness window with the latest attempt failing: now
+    # it's honestly unreachable — but its data is retained, not dropped
+    doc = fleet.fleet_doc(now=10.0)
+    rows = {r["target"]: r for r in doc["engines"]}
+    assert rows["flaky"]["status"] == STATUS_UNREACHABLE
+    assert rows["flaky"]["last_error"]
+    assert doc["gauges"]["corpus"] == 7
+    assert doc["counters"]["exec_total"] == 50
+    assert reg.snapshot()["fleet_scrape_errors_total"] >= before + 3
+    assert doc["engines_online"] == 0
+
+
+def test_fleet_stale_when_scraping_goes_quiet():
+    """STALE is the no-error staleness: the last attempt succeeded but
+    is old (aggregator paused) — distinct from UNREACHABLE."""
+    fleet = FleetAggregator(
+        ["q"], interval=0, fetch=lambda t: _doc("q", {"exec_total": 1}))
+    fleet.poll_once(now=1.0)
+    assert fleet.fleet_doc(now=1.5)["engines"][0]["status"] \
+        == STATUS_ONLINE
+    assert fleet.fleet_doc(now=50.0)["engines"][0]["status"] \
+        == STATUS_STALE
+
+
+def test_fleet_attribution_dedup_by_engine_and_proc():
+    """An engine polled through two managers (or two managers sharing
+    one process-global ledger) is merged exactly once."""
+    eng_state = {"phases": {"mutate": [10, 4, 2]}, "ops": {"1": [10, 4, 2]}}
+    local = {"phases": {"generate": [5, 1, 1]}, "ops": {}}
+    att = {"proc": "proc-1", "local": local,
+           "engines": {"f0": {"engine_id": "eng-dup",
+                              "state": eng_state}}}
+
+    fleet = FleetAggregator(
+        ["a", "b"], interval=0,
+        fetch=lambda t: _doc(t, {"exec_total": 1}, att=dict(att)))
+    fleet.poll_once(now=1.0)
+    fleet.poll_once(now=2.0)  # repeated scrapes must not re-accumulate
+    merged = fleet.merged_ledger()
+    assert merged.totals() == {"execs": 15, "new_signal": 5,
+                               "corpus_adds": 3}
+    doc = fleet.fleet_doc(now=2.0)
+    assert list(doc["engine_ledgers"]) == ["eng-dup"]
+
+
+def test_fleet_collapses_same_process_engines():
+    """Two fuzzers sharing one engine PROCESS share one process-global
+    ledger — seen through two managers under different names/ids, the
+    fleet must count that ledger exactly once."""
+    eng_state = {"phases": {"mutate": [8, 2, 1]}, "ops": {}}
+
+    def fetch(t):
+        att = {"proc": f"mgrproc-{t}", "local": {"phases": {}, "ops": {}},
+               "engines": {f"f-{t}": {"engine_id": f"eng-{t}",
+                                      "proc": "shared-engine-proc",
+                                      "state": eng_state}}}
+        return _doc(t, {"exec_total": 1}, engine_id=f"mgr-{t}", att=att)
+
+    fleet = FleetAggregator(["a", "b"], interval=0, fetch=fetch)
+    fleet.poll_once(now=1.0)
+    assert fleet.merged_ledger().totals() == {
+        "execs": 8, "new_signal": 2, "corpus_adds": 1}
+    # exactly one surviving entry for the shared process
+    assert len(fleet.fleet_doc(now=1.0)["engine_ledgers"]) == 1
+
+
+def test_manager_keeps_one_ledger_per_engine_process(tmp_path, target):
+    """The manager-side half of the same invariant: two names polling
+    with the same proc token ship the same process-global ledger —
+    latest name wins, the state is stored once."""
+    from syzkaller_tpu.manager import Manager, ManagerConfig
+
+    m = Manager(ManagerConfig(workdir=str(tmp_path),
+                              analytics_interval=0), target=target)
+    try:
+        state = {"phases": {"fleetdedup": [10, 4, 2]}, "ops": {}}
+        led = {"proc": "remote-proc", "engine_id": "eng-1",
+               "state": state}
+        m.on_poll("f0", {}, False, [], ledger=led)
+        m.on_poll("f1", {}, False, [],
+                  ledger={**led, "engine_id": "eng-2"})
+        ast = m.attribution_state()
+        assert list(ast["engines"]) == ["f1"]
+        assert ast["engines"]["f1"]["proc"] == "remote-proc"
+        merged = m.merged_attribution_state()
+        assert merged["phases"]["fleetdedup"] == [10, 4, 2]  # not doubled
+    finally:
+        m.close()
+
+
+def test_fleet_endpoints_render(tmp_path, target):
+    """/fleet.json + the /fleet dashboard over two REAL managers."""
+    from syzkaller_tpu.manager import Manager, ManagerConfig
+
+    m1 = Manager(ManagerConfig(name="mgr-a",
+                               workdir=str(tmp_path / "a"),
+                               analytics_interval=0), target=target)
+    m2 = Manager(ManagerConfig(name="mgr-b",
+                               workdir=str(tmp_path / "b"),
+                               analytics_interval=0), target=target)
+    fleet = FleetAggregator([m1.http.addr, m2.http.addr], interval=0)
+    http = FleetHttp(fleet)
+    http.start()
+    try:
+        for tick in range(3):
+            fleet.poll_once(now=time.time() + tick)
+        doc = _get_json(http.addr, "/fleet.json")
+        assert doc["schema_version"] == 1
+        assert len(doc["engines"]) == 2
+        assert doc["engines_online"] == 2
+        assert {r["name"] for r in doc["engines"]} == {"mgr-a", "mgr-b"}
+        assert all(r["engine_id"] for r in doc["engines"])
+        page = urllib.request.urlopen(
+            f"http://{http.addr}/fleet", timeout=10).read().decode()
+        assert "fleet exec rate /s" in page and "<svg" in page
+        assert "mgr-a" in page and "mgr-b" in page and "engines" in page
+        # required fleet metrics really registered + live
+        snap = get_registry().snapshot()
+        assert "fleet_engines_online" in snap
+        assert "fleet_scrape_errors_total" in snap
+    finally:
+        http.stop()
+        m1.close()
+        m2.close()
+
+
+def test_required_metrics_cover_fleet_observability():
+    from syzkaller_tpu.tools.check_metrics import REQUIRED_METRICS, check
+
+    for name in ("journal_records_total", "journal_bytes_total",
+                 "fleet_scrape_errors_total", "fleet_engines_online"):
+        assert name in REQUIRED_METRICS
+    assert check() == []  # every required name has a live registration
+
+
+def test_fleet_cli_main_smoke(tmp_path, target):
+    """The standalone entry point parses targets and serves /fleet.json
+    (constructed directly — main()'s serve-forever loop is not a test)."""
+    from syzkaller_tpu.manager import fleet as fleet_mod
+
+    assert callable(fleet_mod.main)
+    fleet = FleetAggregator(["127.0.0.1:1", "http://x/stats.json"],
+                            interval=0)
+    assert fleet.engines[0].url == "http://127.0.0.1:1/stats.json"
+    assert fleet.engines[1].url == "http://x/stats.json"
+
+
+# ---- the two-engine chaos acceptance ----
+
+
+def _spawn_engine(manager_addr: str, name: str, wd: str, *extra):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.Popen(
+        [sys.executable, "-m", "syzkaller_tpu.engine", "-mock",
+         "-no-detect", "-manager", manager_addr, "-name", name,
+         "-workdir", wd, "-checkpoint-interval", "0.2", *extra],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+
+
+def _engine_ledger_totals(state):
+    out = {"execs": 0, "new_signal": 0, "corpus_adds": 0}
+    for phase, (e, ns, ca) in (state.get("phases") or {}).items():
+        out["execs"] += int(e)
+        out["new_signal"] += int(ns)
+        if phase != "seed":
+            out["corpus_adds"] += int(ca)
+    return out
+
+
+@pytest.mark.chaos
+def test_two_engine_chaos_kill_resume_fleet_exact(tmp_path, target):
+    """The ISSUE 7 acceptance campaign: two engines (real subprocesses,
+    each with its own manager), one SIGKILL'd mid-campaign and resumed
+    with ``--resume``.  Pins: (1) /fleet.json folded counters monotonic
+    across every scrape spanning the restart, (2) the merged attribution
+    ledger exact — fleet corpus_adds == sum of both engines' new_inputs,
+    (3) yield-per-operator/phase trajectory continuous across the
+    restart (post-resume counts >= a pre-kill scrape that predates the
+    restored checkpoint), (4) both engines' journals chain-valid."""
+    from syzkaller_tpu.manager import Manager, ManagerConfig
+
+    m1 = Manager(ManagerConfig(name="mgr-a",
+                               workdir=str(tmp_path / "ma"),
+                               analytics_interval=0), target=target)
+    m2 = Manager(ManagerConfig(name="mgr-b",
+                               workdir=str(tmp_path / "mb"),
+                               analytics_interval=0), target=target)
+    fleet = FleetAggregator([m1.http.addr, m2.http.addr], interval=0)
+    wd_a, wd_b = str(tmp_path / "ea"), str(tmp_path / "eb")
+    ck_a = os.path.join(wd_a, "engine.ckpt")
+    pa = _spawn_engine(m1.rpc.addr, "eng-a", wd_a)
+    pb = None
+    fold_history = []
+
+    def scrape(now=None):
+        fleet.poll_once(now=now)
+        doc = fleet.fleet_doc(now=now)
+        fold_history.append(dict(doc["counters"]))
+        return doc
+
+    try:
+        # engine B runs a clean finite campaign alongside
+        pb = _spawn_engine(m2.rpc.addr, "eng-b", wd_b,
+                           "-iterations", "300")
+        # wait until manager A holds engine A's ledger AND a checkpoint
+        deadline = time.time() + 120
+        pre = None
+        while time.time() < deadline:
+            if pa.poll() is not None:
+                pytest.fail("engine A died early: "
+                            + pa.stderr.read().decode()[-2000:])
+            doc = _get_json(m1.http.addr, "/stats.json")
+            engs = doc["attribution_state"]["engines"]
+            if engs.get("eng-a", {}).get("state") and \
+                    os.path.exists(ck_a):
+                pre = engs["eng-a"]["state"]
+                break
+            time.sleep(0.05)
+        assert pre is not None, "engine A never shipped a ledger"
+        scrape()
+        # a checkpoint NEWER than the pre-kill scrape: the resumed
+        # trajectory is then guaranteed >= `pre` cell-wise
+        mtime0 = os.path.getmtime(ck_a)
+        while os.path.getmtime(ck_a) <= mtime0 and \
+                time.time() < deadline:
+            time.sleep(0.05)
+        os.kill(pa.pid, signal.SIGKILL)
+        pa.wait(timeout=30)
+        scrape()  # mid-outage scrape: totals must not regress
+        # resume the killed engine; finite run ends with a final poll
+        pa = _spawn_engine(m1.rpc.addr, "eng-a", wd_a,
+                           "--resume", "-iterations", "200")
+        out_a = pa.communicate(timeout=120)
+        assert pa.returncode == 0, out_a[1].decode()[-2000:]
+        out_b = pb.communicate(timeout=120)
+        assert pb.returncode == 0, out_b[1].decode()[-2000:]
+        final = scrape()
+    finally:
+        for p in (pa, pb):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait(timeout=30)
+        m1.close()
+        m2.close()
+
+    # (1) folded fleet counters monotonic across the kill + resume
+    for a, b in zip(fold_history, fold_history[1:]):
+        for k, v in a.items():
+            assert b.get(k, 0) >= v, \
+                f"fleet counter {k} regressed across restart: {v}->{b.get(k)}"
+
+    # (2) merged ledger EXACT: fleet corpus_adds == sum of engines'
+    # new_inputs (each engine's final poll shipped its final absolute
+    # ledger; the manager snapshot's new_inputs came from the same poll)
+    want_ni = 0
+    for m in (m1, m2):
+        want_ni += int(m.snapshot().get("new_inputs", 0))
+    ledgers = final["engine_ledgers"]
+    assert len(ledgers) == 2, f"expected 2 engines, got {list(ledgers)}"
+    got = sum(_engine_ledger_totals(st)["corpus_adds"]
+              for st in ledgers.values())
+    assert got == want_ni > 0
+    # engine identity is the workdir-persistent id for both
+    ids = {open(os.path.join(wd, "engine_id")).read().strip()
+           for wd in (wd_a, wd_b)}
+    assert set(ledgers) == ids
+
+    # (3) trajectory continuity: the resumed engine's final per-phase /
+    # per-operator cells dominate the pre-kill scrape (which predates
+    # the checkpoint the resume restored)
+    eid_a = open(os.path.join(wd_a, "engine_id")).read().strip()
+    post = ledgers[eid_a]
+    for table in ("phases", "ops"):
+        for key, cell in (pre.get(table) or {}).items():
+            after = (post.get(table) or {}).get(key)
+            assert after is not None, f"{table}[{key}] vanished on resume"
+            assert all(b >= a for a, b in zip(cell, after)), \
+                f"{table}[{key}] regressed: {cell} -> {after}"
+
+    # (4) both journals chain-valid from the workdirs alone
+    from syzkaller_tpu.telemetry import journal as J
+
+    for wd in (wd_a, wd_b):
+        records, defects = J.read_records(wd)
+        assert [d for d in defects if not d.startswith("tail: ")] == []
+        assert J.verify_records(records) == []
+    rep = J.replay(wd_a)
+    assert rep["restores"] == 1  # the --resume really replayed state

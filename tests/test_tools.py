@@ -198,3 +198,89 @@ class TestFmt:
         desc = parse(f.read_text(), str(f))
         names = {type(n).__name__ for n in desc.nodes}
         assert "CallDef" in names and "StructDef" in names
+
+
+class TestJournalcat:
+    """journalcat (ISSUE 7 satellite): decode/filter a campaign journal
+    and verify the CRC/seq chain end-to-end — wired into test_tools like
+    check_metrics so the tool keeps decoding what the engine writes."""
+
+    def _make_journal(self, tmp_path):
+        from syzkaller_tpu.telemetry.journal import CampaignJournal
+
+        j = CampaignJournal(str(tmp_path / "journal.jsonl"),
+                            engine_id="eng-t")
+        j.emit("campaign_start", procs=2)
+        j.emit("corpus_add", phase="mutate", ops=[1], row=3, h="ab" * 8)
+        j.emit("signal", n=4, phase="mutate", ops=[1])
+        j.emit("env_restart", env=1, failures=1)
+        j.emit("corpus_add", phase="seed", h="cd" * 8)
+        j.emit("campaign_end", execs=10, new_inputs=1)
+        j.close()
+        return str(tmp_path)
+
+    def test_dump_and_verify(self, tmp_path, capsys):
+        from syzkaller_tpu.tools import journalcat
+
+        wd = self._make_journal(tmp_path)
+        assert journalcat.main([wd]) == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        assert len(out) == 6
+        assert json.loads(out[0])["ev"] == "campaign_start"
+        assert journalcat.main([wd, "--verify"]) == 0
+        assert "0 problem(s)" in capsys.readouterr().out
+
+    def test_filters(self, tmp_path, capsys):
+        from syzkaller_tpu.tools import journalcat
+
+        wd = self._make_journal(tmp_path)
+        assert journalcat.main([wd, "--type", "corpus_add"]) == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        assert len(out) == 2
+        assert all(json.loads(l)["ev"] == "corpus_add" for l in out)
+        assert journalcat.main(
+            [wd, "--type", "corpus_add", "--phase", "mutate"]) == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        assert len(out) == 1 and json.loads(out[0])["row"] == 3
+        assert journalcat.main([wd, "--env", "1"]) == 0
+        out = capsys.readouterr().out.strip().splitlines()
+        assert len(out) == 1
+        assert json.loads(out[0])["ev"] == "env_restart"
+
+    def test_replay_summary(self, tmp_path, capsys):
+        from syzkaller_tpu.tools import journalcat
+
+        wd = self._make_journal(tmp_path)
+        assert journalcat.main([wd, "--replay"]) == 0
+        doc = json.loads(capsys.readouterr().out.strip())
+        assert doc["new_inputs_total"] == 1   # seed add excluded
+        assert doc["corpus_total"] == 2
+        assert doc["signal_total"] == 4
+        assert doc["engines"] == ["eng-t"]
+
+    def test_corruption_fails_truncation_tolerated(self, tmp_path,
+                                                   capsys):
+        from syzkaller_tpu.tools import journalcat
+
+        wd = self._make_journal(tmp_path)
+        path = tmp_path / "journal.jsonl"
+        blob = bytearray(path.read_bytes())
+        idx = blob.index(b'"failures":1')
+        blob[idx + 11:idx + 12] = b"7"  # mid-file flip: real corruption
+        path.write_bytes(bytes(blob))
+        assert journalcat.main([wd, "--verify"]) == 1
+        assert "crc mismatch" in capsys.readouterr().err
+        # a truncated FINAL record is the tolerated SIGKILL artifact
+        wd2 = tmp_path / "ok"
+        wd2.mkdir()
+        self._make_journal(wd2)
+        p2 = wd2 / "journal.jsonl"
+        p2.write_bytes(p2.read_bytes()[:-15])
+        assert journalcat.main([str(wd2), "--verify"]) == 0
+        assert "tolerated crash artifact" in capsys.readouterr().err
+
+    def test_missing_journal_is_usage_error(self, tmp_path, capsys):
+        from syzkaller_tpu.tools import journalcat
+
+        assert journalcat.main([str(tmp_path / "nope")]) == 2
+        assert "no journal" in capsys.readouterr().err
