@@ -3,8 +3,19 @@
 Configs (BASELINE.json "configs"):
   mutate          — batched device mutation vs single-proc host mutation
                     (reference tools/syz-mutate in a loop)
-  cover_merge_10k — new-signal dedup over 10k recorded traces
-                    (reference pkg/cover merge)
+  cover_merge_sweep — new-signal dedup over recorded traces (reference
+                    pkg/cover merge) at nbits {2^20, 2^22, 2^26} x
+                    traces {1k, 10k, 100k}: the per-trace sequential
+                    scan fold ("device", the pre-ISSUE 8 path), the
+                    python-set host reference, and the fused
+                    merge_and_new entry (ops/cover, ISSUE 8) in every
+                    cell — tolerant nulls where the engine predates the
+                    fused entry, so the SAME harness runs pre+post
+  minimize_bisect — triage minimize round-trip economy: the serial
+                    one-exec-per-probe path vs batched-bisection
+                    rounds at equal item set, reporting execs and
+                    wall-clock per minimized item and whether both
+                    modes minimized to identical programs
   e2e_triage      — the full engine loop: device candidate factory ->
                     exec -> signal fold -> triage, vs the host-only loop
                     (reference syz-manager+VMs triage progs/sec).  Uses
@@ -176,61 +187,184 @@ def bench_host_mutate(target, ncalls=16):
 
 
 # ------------------------------------------------------------------ #
-# config[1]: cover merge over 10k traces
+# config[1]: cover merge sweep (ISSUE 8 — fused merge+new vs the scan)
+
+COVER_SWEEP_NBITS = (1 << 20, 1 << 22, 1 << 26)
+COVER_SWEEP_TRACES = (1_000, 10_000, 100_000)
 
 
-def bench_cover_merge(n_traces=10_000, pcs=64, nbits=1 << 22):
-    import jax
-    import jax.numpy as jnp
+def _gen_traces(n_traces, pcs=64, seed=7):
+    """Synthetic KCOV-shaped traces: a shared hot set (kernel entry
+    paths) + a novel tail — the same generator every round has used."""
     import numpy as np
 
-    from syzkaller_tpu.ops import cover
-
-    rng = np.random.default_rng(7)
-    # traces share a common hot set (kernel entry paths) + a novel tail,
-    # like real KCOV output
+    rng = np.random.default_rng(seed)
     hot = rng.integers(0, 1 << 18, size=1 << 12, dtype=np.uint32)
-    traces = np.where(
+    return np.where(
         rng.random((n_traces, pcs)) < 0.8,
         hot[rng.integers(0, hot.size, size=(n_traces, pcs))],
         rng.integers(0, 1 << 30, size=(n_traces, pcs)).astype(np.uint32))
 
-    @jax.jit
-    def fold_all(bits, ts):
-        def step(bits, t):
-            fresh = cover.signal_new(bits, t)
-            bits = cover.signal_add(bits, t)
-            return bits, fresh
 
-        bits, fresh = jax.lax.scan(step, bits, ts)
-        return bits, jnp.sum(fresh)
+def bench_cover_merge_sweep():
+    """traces/sec of the new-signal dedup at every (nbits, traces)
+    design point, three ways per cell:
 
-    ts = jnp.asarray(traces)
-    bits0 = cover.make_bitset(nbits)
-    out = fold_all(bits0, ts)  # warmup/compile
-    _sync(out)
-    best = 0.0
-    for _ in range(3):
-        t0 = time.perf_counter()
-        out = fold_all(cover.make_bitset(nbits), ts)
-        _sync(out)
-        best = max(best, n_traces / (time.perf_counter() - t0))
+      device — the sequential per-trace scan fold (signal_new +
+               signal_add under lax.scan): the pre-ISSUE 8 device path,
+               measured on a capped trace window (the [nwords] carry
+               makes 100k-step scans at 2^26 bits minutes-slow; the
+               scan is per-trace linear, so the rate transfers —
+               ``device_measured_traces`` records the honesty).
+      host   — python sets (pkg/cover SignalNew/SignalAdd), the same
+               2000-trace estimator every round has used.
+      fused  — ops/cover.merge_and_new: ONE batched fold of the whole
+               trace set (sequential-prefix popcount-delta verdicts +
+               merged accumulator in one pass).  None when the engine
+               predates the fused entry (the pre harness), so the SAME
+               harness runs both sides.
+    """
+    import jax
+    import jax.numpy as jnp
 
-    # host reference: python sets (pkg/cover SignalNew/SignalAdd)
-    def host_run(seconds):
-        done = 0
-        t_end = time.perf_counter() + seconds
-        while time.perf_counter() < t_end:
-            max_sig = set()
-            for row in traces[:2000]:
-                s = set(row.tolist())
-                if not s <= max_sig:
-                    max_sig |= s
-            done += 2000
-        return done
+    from syzkaller_tpu.ops import cover
 
-    host = _median_rate(host_run, reps=3)
-    return best, host
+    merge = getattr(cover, "merge_and_new", None)
+    out = {}
+    for n_traces in COVER_SWEEP_TRACES:
+        traces = _gen_traces(n_traces)
+
+        # host reference, measured once per trace set (independent of
+        # nbits — exact sets don't hash into a table)
+        def host_run(seconds):
+            done = 0
+            t_end = time.perf_counter() + seconds
+            while time.perf_counter() < t_end:
+                max_sig = set()
+                for row in traces[:2000]:
+                    s = set(row.tolist())
+                    if not s <= max_sig:
+                        max_sig |= s
+                done += 2000
+            return done
+
+        host = _median_rate(host_run, reps=3)
+
+        for nbits in COVER_SWEEP_NBITS:
+            cell = {}
+            # --- device: the old sequential scan fold ---
+            cap = min(n_traces, 2_000 if nbits >= 1 << 26 else 10_000)
+            reps = 1 if nbits >= 1 << 26 else 3
+
+            @jax.jit
+            def fold_all(bits, ts):
+                def step(bits, t):
+                    fresh = cover.signal_new(bits, t)
+                    bits = cover.signal_add(bits, t)
+                    return bits, fresh
+
+                bits, fresh = jax.lax.scan(step, bits, ts)
+                return bits, jnp.sum(fresh)
+
+            ts = jnp.asarray(traces[:cap])
+            _sync(fold_all(cover.make_bitset(nbits), ts))  # warm/compile
+            best = 0.0
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                _sync(fold_all(cover.make_bitset(nbits), ts))
+                best = max(best, cap / (time.perf_counter() - t0))
+            cell["device"] = round(best, 1)
+            cell["device_measured_traces"] = cap
+            cell["host"] = round(host, 1)
+            # --- fused: one merge_and_new pass over the FULL set,
+            # called the way the engine calls it (host-resident
+            # accumulator + trace batch — the dispatcher picks the
+            # best fused implementation for this platform) ---
+            if merge is None:
+                cell["fused"] = None
+            else:
+                import numpy as np
+
+                def acc0():
+                    return np.zeros(nbits // 32, np.uint32)
+
+                _sync(merge(acc0(), traces))  # warm/compile
+                fbest = 0.0
+                for _ in range(2):
+                    t0 = time.perf_counter()
+                    _sync(merge(acc0(), traces))
+                    fbest = max(fbest,
+                                n_traces / (time.perf_counter() - t0))
+                cell["fused"] = round(fbest, 1)
+            out[f"nbits{nbits.bit_length() - 1}_t{n_traces // 1000}k"] = \
+                cell
+    return out
+
+
+# ------------------------------------------------------------------ #
+# config: batched-bisection minimize round-trip economy (ISSUE 8)
+
+
+def bench_minimize_bisect(target, n_progs=4, length=8):
+    """Equal triage workload through the sequential one-exec-per-probe
+    path and the batched-bisection round scheduler (MockEnv fleet, 4
+    envs): execs and wall-clock per minimized item, the serial
+    round-trip count each mode pays, and whether both modes minimized
+    to byte-identical programs.  getattr/field-tolerant: a pre engine
+    without the ``minimize_bisect`` knob reports a null batched cell so
+    the SAME harness runs pre+post."""
+    import dataclasses
+
+    from syzkaller_tpu.engine.fuzzer import Fuzzer, FuzzerConfig
+    from syzkaller_tpu.prog.encoding import serialize
+    from syzkaller_tpu.prog.generation import generate
+    from syzkaller_tpu.telemetry import get_registry
+
+    has_knob = "minimize_bisect" in {
+        fld.name for fld in dataclasses.fields(FuzzerConfig)}
+    reg = get_registry()
+
+    def run(batched):
+        kw = {"minimize_bisect": batched} if has_knob else {}
+        cfg = FuzzerConfig(mock=True, use_device=False, procs=4,
+                           program_length=length, smash_mutations=0,
+                           generate_period=1 << 30, **kw)
+        with Fuzzer(target, cfg, seed=11) as f:
+            for i in range(n_progs):
+                f.execute(generate(target, 900 + i, length), "exec_fuzz")
+            items = f.queue.depths()["triage"]
+            before = reg.snapshot()
+            n0 = f.stats["exec_total"]
+            t0 = time.perf_counter()
+            while any(v for v in f.queue.depths().values()):
+                f.step()
+            wall = time.perf_counter() - t0
+            delta = reg.delta(before)
+            probe_execs = f.stats["exec_total"] - n0
+            rounds = delta.get("minimize_bisect_rounds_total", 0)
+            items = max(items, 1)
+            return {
+                "items": items,
+                "execs": probe_execs,
+                "execs_per_item": round(probe_execs / items, 1),
+                "wall_s": round(wall, 3),
+                "wall_per_item_s": round(wall / items, 4),
+                # the serial-round-trip axis: every probe is its own
+                # round trip sequentially; a round is one trip batched
+                "rounds": rounds or None,
+                "serial_roundtrips_per_item": round(
+                    (rounds if rounds else probe_execs) / items, 1),
+                "new_inputs": f.stats["new_inputs"],
+            }, sorted(serialize(p) for p in f.corpus)
+
+    seq, corpus_seq = run(batched=False)
+    if has_knob:
+        bis, corpus_bis = run(batched=True)
+        equal = corpus_seq == corpus_bis
+    else:
+        bis, equal = None, None
+    return {"sequential": seq, "batched": bis,
+            "minimized_equal": equal}
 
 
 # ------------------------------------------------------------------ #
@@ -559,11 +693,18 @@ def main(argv=None):
     dev_mut, host_mut = dev_host["dev_mut"], dev_host["host_mut"]
 
     def _cover():
-        dev_cov, host_cov = bench_cover_merge()
-        return {"device": round(dev_cov, 1), "host": round(host_cov, 1),
-                "unit": "traces/sec"}
+        res = bench_cover_merge_sweep()
+        res["unit"] = "traces/sec per (nbits, traces) cell"
+        return res
 
-    run_config("cover_merge_10k", _cover)
+    run_config("cover_merge_sweep", _cover)
+
+    def _minimize():
+        res = bench_minimize_bisect(target)
+        res["unit"] = "per-minimized-item execs / round-trips"
+        return res
+
+    run_config("minimize_bisect", _minimize)
 
     def _hints():
         dev_hint, host_hint = bench_hints()

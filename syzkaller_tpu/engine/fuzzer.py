@@ -126,6 +126,13 @@ class FuzzerConfig:
     # occupancy-triggered admission-Bloom reset so early-campaign
     # jackpot rows stop pinning the weighted sampler forever
     arena_yield_decay: float = 0.5
+    # ---- batched-bisection triage minimize (ISSUE 8) ----
+    # drain every queued triage item of one priority class together and
+    # run their rerun + minimize ladders as fleet-wide probe ROUNDS
+    # (one batch of probe executions per round) instead of one serial
+    # exec round-trip per probe per item
+    minimize_bisect: bool = True
+    minimize_batch: int = 8             # max triage items per batch
     # ---- durable campaign journal (telemetry/journal.py) ----
     # enabled whenever a workdir is configured: every state transition
     # (checkpoints, env supervision, degradation, admission resets,
@@ -257,6 +264,18 @@ class Fuzzer:
             help="prefix cache-warmer executions scheduled by the "
                  "drain (not counted in exec_total — they complete no "
                  "program)")
+        # batched-bisection triage minimize (ISSUE 8): rounds are the
+        # serial-round-trip axis the bench compares against the old
+        # one-exec-per-probe path; batch execs are the probes they carry
+        self._m_bisect_rounds = reg.counter(
+            "minimize_bisect_rounds_total",
+            help="batched-bisection triage rounds executed (one "
+                 "fleet-wide probe batch per round — the serial exec "
+                 "round-trip axis batching collapses)")
+        self._m_bisect_execs = reg.counter(
+            "minimize_batch_execs_total",
+            help="probe executions carried by batched-bisection triage "
+                 "rounds (also counted in exec_triage/exec_minimize)")
         # engine-side memo of which prefix hashes have had their signal
         # scanned for novelty once (bounded LRU-set; guards the triage
         # scan skip for both the continuation and the fallback path)
@@ -291,6 +310,19 @@ class Fuzzer:
         # pipeline itself is built after the env fleet)
         self._device = None
         self._max_bits = None  # device bitset mirror of max_signal
+        # triage novelty SCREEN (ISSUE 8): a packed-bitset superset
+        # image of max_signal — every member's bit is set, so a CLEAR
+        # bit proves a signal is new and the drain's novelty scans can
+        # run as one fused merge_and_new pass instead of a per-signal
+        # python set walk.  Maintained at every max_signal growth site
+        # (_screen_note); allocated before connect (which imports the
+        # manager's max_signal).  Host-only engines keep the exact walk.
+        self._tri_bits = None
+        if self.cfg.use_device:
+            import numpy as _np
+
+            nbits = 1 << (self.cfg.mirror_bits - 1).bit_length()
+            self._tri_bits = _np.zeros(nbits // 32, dtype=_np.uint32)
 
         # ---- durable identity + campaign journal (before anything
         # that emits: manager connect imports seed corpus entries) ----
@@ -325,6 +357,7 @@ class Fuzzer:
         self.choice_table = build_choice_table(
             target, conn.get("prios"), self._enabled)
         self.max_signal.update(conn.get("max_signal", ()))
+        self._screen_note(conn.get("max_signal", ()))
         for text in conn.get("corpus", ()):
             self._add_corpus_text(text)
         for text in conn.get("candidates", ()):
@@ -497,35 +530,57 @@ class Fuzzer:
             self.max_signal.update(fresh)
             self.new_signal.update(fresh)
             self._m_new_signal.inc(len(fresh))
+            self._screen_note(fresh)
         return len(fresh)
+
+    def _screen_note(self, sigs) -> None:
+        """Mirror a max-signal addition into the triage novelty screen.
+        The screen's soundness (bit clear => the signal is definitely
+        NOT in max_signal) requires every member's bit to be set, so
+        every growth site of max_signal funnels here."""
+        if self._tri_bits is None or not sigs:
+            return
+        from ..ops import cover as _cover
+
+        _cover.bitset_add_host(self._tri_bits, sigs)
+
+    @staticmethod
+    def _pack_signal_rows(rows):
+        """SENT-pad a ragged list of signal lists into the [N, S] u32
+        array the fused merge_and_new entries consume (values wrap to
+        u32 exactly like the bitset index mapping does)."""
+        import numpy as np
+
+        s = max((len(r) for r in rows), default=0)
+        arr = np.full((len(rows), s), 0xFFFFFFFF, dtype=np.uint32)
+        for k, sig in enumerate(rows):
+            if sig:
+                a = np.asarray(sig, dtype=np.uint64) & \
+                    np.uint64(0xFFFFFFFF)
+                arr[k, :a.size] = a.astype(np.uint32)
+        return arr
 
     def _fold_batch_signal(self, batch_sigs) -> None:
         """Fold one device batch's executed signal into the max-signal
-        bitset mirror (sparse scatter: at DEFAULT_BITS-scale a dense
-        per-program [B, W] pack would be gigabytes; the executed signal is
-        a few hundred PCs).  The per-batch new-bit count feeds the stats
-        the manager graphs; exact-set bookkeeping already happened
-        per-program in execute()."""
+        bitset mirror via the fused merge + new-signal entry
+        (ops/cover.merge_and_new_host, ISSUE 8): one pass computes the
+        per-row popcount-delta counts AND updates the accumulator in
+        place — no per-row gather/scatter split, no dense [B, W] pack
+        (at DEFAULT_BITS-scale that would be gigabytes).  The summed
+        count feeds the stats the manager graphs; exact-set bookkeeping
+        already happened per-program in execute()."""
         if self._max_bits is None:
             return
-        import numpy as np
+        from ..ops import cover as _cover
 
-        flat = [s for sigs in batch_sigs for s in sigs or ()]
-        if not flat:
+        rows = [s for s in batch_sigs if s]
+        if not rows:
             return
         t0 = time.perf_counter()
-        nbits = self._max_bits.shape[0] * 32
-        h = np.asarray(flat, dtype=np.uint64) & np.uint64(nbits - 1)
-        words = (h >> np.uint64(5)).astype(np.int64)
-        bits = np.uint32(1) << (h & np.uint64(31)).astype(np.uint32)
-        uw, inv = np.unique(words, return_inverse=True)
-        m = np.zeros(len(uw), dtype=np.uint32)
-        np.bitwise_or.at(m, inv, bits)
-        new = m & ~self._max_bits[uw]
-        count = int(sum(int(x).bit_count() for x in new))
-        self._max_bits[uw] |= m
+        counts, _mask, _ = _cover.merge_and_new_host(
+            self._max_bits, self._pack_signal_rows(rows), update=True)
         self.stats["device_new_bits"] = self.stats.get(
-            "device_new_bits", 0) + count
+            "device_new_bits", 0) + int(counts.sum())
         self._h_signal_fold.observe(time.perf_counter() - t0)
 
     # ---- execution ----
@@ -586,34 +641,65 @@ class Fuzzer:
             self._triage(item)
 
     def _triage(self, item: TriageItem) -> None:
+        """Sequential triage: the probe phase executes directly (one
+        serial exec round-trip per probe, all on env 0 — the reference
+        shape), then the acceptance phase lands the result."""
+        res = self._triage_probe_phase(
+            item,
+            lambda p, stat, opts: self.execute(p, stat, opts,
+                                               scan_new=False))
+        if res is not None:
+            self._finish_triage(item, *res)
+
+    def _triage_probe_phase(self, item: TriageItem, executor):
+        """The EXECUTION half of triage (reference triageInput
+        fuzzer.go:521-625): stability reruns, signal intersection, and
+        the minimize ladder — every execution goes through ``executor
+        (prog, stat, opts) -> infos``, so the batched-bisection
+        scheduler can rendezvous the probes into fleet-wide rounds
+        while this per-item logic stays byte-for-byte the sequential
+        algorithm (the minimized-program-identity guarantee).  Touches
+        only thread-safe engine state (execute/stats); all acceptance
+        mutations live in ``_finish_triage``.
+
+        Returns ``None`` to drop the item (flaky/irrelevant signal) or
+        ``(prog, call_index, inter, cover)``."""
         opts = ExecOpts(collect_signal=True, collect_cover=True)
         inter: Optional[Set[int]] = None
         cover: Set[int] = set()
         for _ in range(self.cfg.triage_reruns):
-            infos = self.execute(item.prog, "exec_triage", opts,
-                                 scan_new=False)
+            infos = executor(item.prog, "exec_triage", opts)
             sig = self._call_signal(infos, item.call_index)
             if sig is None:
                 continue
             cover.update(self._call_cover(infos, item.call_index) or ())
             inter = set(sig) if inter is None else (inter & set(sig))
             if not inter:
-                return  # flaky signal: drop
+                return None  # flaky signal: drop
         if not inter:
-            return
+            return None
         relevant = inter & set(item.signal) if item.signal else inter
         if item.signal and not relevant:
-            return
+            return None
 
         def pred(p: Prog, call_index: int) -> bool:
-            infos = self.execute(p, "exec_minimize", opts, scan_new=False)
+            infos = executor(p, "exec_minimize", opts)
             sig = self._call_signal(infos, call_index)
             return sig is not None and relevant.issubset(set(sig))
 
+        prog, call_index = item.prog, item.call_index
         if not item.minimized:
-            item.prog, item.call_index = minimize(
-                item.prog, item.call_index, pred)
+            prog, call_index = minimize(prog, call_index, pred)
+        return prog, call_index, inter, cover
 
+    def _finish_triage(self, item: TriageItem, prog: Prog,
+                       call_index: int, inter: Set[int],
+                       cover: Set[int]) -> None:
+        """The ACCEPTANCE half of triage: signal/ledger/corpus/journal
+        mutations, run on the scheduling thread only (and, for batched
+        bisection, in queue order — so the corpus and attribution
+        trajectories are identical to the sequential path's)."""
+        item.prog, item.call_index = prog, call_index
         sig_list = sorted(inter)
         fresh = self._note_signal(sig_list)
         # credit the new signal (and, below, the corpus addition) to the
@@ -658,6 +744,34 @@ class Fuzzer:
         self._report_new_input(serialize(item.prog), item.call_index,
                                sig_list, sorted(cover))
         self.queue.push_smash(SmashItem(item.prog, item.call_index))
+
+    def _triage_batch(self, items: List[TriageItem]) -> None:
+        """Batched-bisection triage (ISSUE 8): run every queued item's
+        rerun + minimize ladder CONCURRENTLY, with each probe execution
+        rendezvoused into fleet-wide ROUNDS — one batch of probe
+        programs per round, fanned across the executor fleet — instead
+        of one serial exec round-trip per probe per item.  Minimize is
+        just a candidate-execution schedule; the per-item decision
+        ladder (prog/mutation.minimize) runs unmodified in its own
+        worker, so each item's minimized program is byte-identical to
+        what the sequential path produces on the same env.  Acceptance
+        (_finish_triage) runs afterwards on this thread in queue
+        order, so corpus/ledger/journal trajectories match the
+        sequential path's ordering exactly."""
+        if len(items) == 1:
+            self.triage(items[0])
+            return
+        t0 = time.perf_counter()
+        with span("fuzzer.triage_bisect"):
+            outs = _BisectRounds(self, items).run()
+        for item, res in zip(items, outs):
+            if res is not None:
+                self._finish_triage(item, *res)
+        # keep the per-item latency series comparable with the
+        # sequential path (which observes one triage per item)
+        dt = (time.perf_counter() - t0) / len(items)
+        for _ in items:
+            self._h_triage.observe(dt)
 
     def _report_new_input(self, text: str, call_index: int,
                           signal: List[int], cover: List[int]) -> None:
@@ -1126,15 +1240,47 @@ class Fuzzer:
         new-signal test never re-parses known prefix coverage (the
         prelude mmap at index 0 is always scanned: it runs fresh).
 
+        The scan itself is ONE fused merge+new pass (ISSUE 8,
+        ops/cover.merge_and_new_host) over every call's signal against
+        the max-signal SCREEN bitset instead of a per-signal python set
+        walk: a clear bit PROVES novelty (the screen is a superset
+        image of max_signal), so only flagged calls pay the exact host
+        diff that names the novel PCs.  Two accepted proxy trades,
+        both the shape the device admission gate already makes: a
+        novel signal every one of whose bits collides with known
+        signal is screened out (odds ~ screen occupancy on the 2^26
+        default), and a call whose novelty is entirely claimed by an
+        earlier call of the SAME execution defers to it (first-claim,
+        like the prefix-scan dedup — the claimant's triage re-executes
+        the program and re-enqueues anything real).
+
         Returns False when novel signal was found but the row failed to
         decode (the codec long tail) — the triage work was LOST, so the
         caller must NOT mark the prefix hash as scanned: a sibling's
         scan may still decode and rescue the group's coverage."""
+        cand = [info for info in infos
+                if not (1 <= info.index <= skip_prefix_calls)]
+        if self._tri_bits is not None and len(cand) > 1:
+            import numpy as np
+
+            from ..ops import cover as _cover
+
+            rows = [info.signal for info in cand]
+            arr = self._pack_signal_rows(rows)
+            if arr.shape[1]:
+                _, mask, _ = _cover.merge_and_new_host(
+                    self._tri_bits, arr)
+                # a signal VALUE that wraps to the SENT sentinel packs
+                # as padding and is invisible to the screen — force
+                # such calls onto the exact path instead of silently
+                # dropping their (unscreenable) novelty
+                packed = (arr != np.uint32(0xFFFFFFFF)).sum(axis=1)
+                cand = [info for k, (info, m) in enumerate(zip(cand,
+                                                               mask))
+                        if m or packed[k] < len(rows[k])]
         decoded = None
         ok = True
-        for info in infos:
-            if 1 <= info.index <= skip_prefix_calls:
-                continue
+        for info in cand:
             diff = self._signal_diff(info.signal)
             if not diff:
                 continue
@@ -1374,7 +1520,18 @@ class Fuzzer:
                 # fully-stale batch: fall through to regular queue work
         item = self.queue.pop()
         if isinstance(item, TriageItem):
-            self.triage(item)
+            # batched-bisection minimize (ISSUE 8): drain the rest of
+            # this priority class and run every item's ladder as
+            # fleet-wide probe rounds
+            batch = [item]
+            if self.cfg.minimize_bisect and self.cfg.minimize_batch > 1:
+                batch += self.queue.pop_triage_batch(
+                    self.cfg.minimize_batch - 1,
+                    from_candidate=item.from_candidate)
+            if len(batch) > 1:
+                self._triage_batch(batch)
+            else:
+                self.triage(item)
             return
         if isinstance(item, CandidateItem):
             self.execute(item.prog, "exec_candidate")
@@ -1454,6 +1611,7 @@ class Fuzzer:
         for text in r.get("candidates", ()):
             self._push_candidate_text(text)
         self.max_signal.update(r.get("max_signal", ()))
+        self._screen_note(r.get("max_signal", ()))
         self.new_signal.clear()
         # the manager is reachable again: drain the retained new_input
         # backlog (reports that failed while it was down)
@@ -1681,6 +1839,12 @@ class Fuzzer:
             self.corpus_signal = corpus_signal
         self.max_signal = max_signal
         self.new_signal = new_signal
+        if self._tri_bits is not None:
+            # rebuild the triage novelty screen as the exact image of
+            # the restored max_signal (a stale superset would screen
+            # out signal the restored engine has never seen)
+            self._tri_bits[:] = 0
+            self._screen_note(max_signal)
         with self._stats_lock:
             self.stats.update(st["stats"])
         self.rng.rng.setstate(st["seed_rng"])
@@ -1695,6 +1859,129 @@ class Fuzzer:
             self.queue.push_candidate(c)
         for s in smash_items:
             self.queue.push_smash(s)
+
+
+class _BisectRounds:
+    """The batched-bisection probe scheduler (ISSUE 8): N triage items'
+    probe phases run in their own worker threads; every execution they
+    request blocks in a rendezvous until ALL still-active items have a
+    probe staged, then the whole round executes as ONE batch fanned
+    across the executor fleet (each item is pinned to a HOME env for
+    its entire rerun + minimize ladder, so its verdict stream is
+    internally consistent and — at one env — byte-identical to the
+    sequential path).  Rounds collapse the serial-round-trip count per
+    minimized item from "every probe" to "every bisection step of the
+    deepest item": the axis ``minimize_bisect_rounds_total`` counts and
+    the bench's ``minimize_bisect`` config compares.
+
+    An env death during a round costs that ITEM, not the campaign
+    (``errors_minimize_bisect_total``): the supervision philosophy —
+    the sequential path would instead have propagated and killed the
+    scheduling loop with the item."""
+
+    def __init__(self, fuzzer: "Fuzzer", items: List[TriageItem]):
+        self.f = fuzzer
+        self.items = items
+        self._cond = threading.Condition()
+        self._pending: Dict[int, tuple] = {}   # idx -> (prog, stat, opts)
+        self._results: Dict[int, object] = {}  # idx -> infos | exception
+        self._active = 0
+        self._out: List[Optional[tuple]] = [None] * len(items)
+        healthy = sorted(fuzzer.supervisor.healthy_envs()) or \
+            list(range(len(fuzzer.envs)))
+        self._home = [healthy[i % len(healthy)]
+                      for i in range(len(items))]
+
+    # ---- item-worker side ----
+
+    def _exec(self, idx: int, prog: Prog, stat: str, opts: ExecOpts):
+        with self._cond:
+            self._pending[idx] = (prog, stat, opts)
+            self._cond.notify_all()
+            while idx not in self._results:
+                self._cond.wait()
+            res = self._results.pop(idx)
+        if isinstance(res, BaseException):
+            raise res
+        return res
+
+    def _worker(self, idx: int, item: TriageItem) -> None:
+        try:
+            self._out[idx] = self.f._triage_probe_phase(
+                item,
+                lambda p, stat, opts: self._exec(idx, p, stat, opts))
+        except BaseException as e:  # noqa: BLE001 — contain per item
+            count_error("minimize_bisect", e)
+            self._out[idx] = None
+        finally:
+            with self._cond:
+                self._active -= 1
+                self._pending.pop(idx, None)
+                self._cond.notify_all()
+
+    # ---- driver side (the engine's scheduling thread) ----
+
+    def run(self) -> List[Optional[tuple]]:
+        threads = [threading.Thread(
+            target=self._worker, args=(i, item), daemon=True,
+            name=f"syztpu-bisect-{i}")
+            for i, item in enumerate(self.items)]
+        self._active = len(threads)
+        for t in threads:
+            t.start()
+        pool = self.f._get_drain_pool() if len(self.f.envs) > 1 else None
+        while True:
+            with self._cond:
+                # a round is ready when every still-active worker has
+                # staged its next probe (finished workers left the set)
+                while self._active > 0 and \
+                        len(self._pending) < self._active:
+                    self._cond.wait()
+                if self._active == 0 and not self._pending:
+                    break
+                batch = list(self._pending.items())
+                self._pending.clear()
+            self._run_round(batch, pool)
+        for t in threads:
+            t.join()
+        return self._out
+
+    def _run_round(self, batch, pool) -> None:
+        f = self.f
+        f._m_bisect_rounds.inc()
+        f._m_bisect_execs.inc(len(batch))
+        with f._stats_lock:
+            f.stats["minimize_rounds"] = f.stats.get(
+                "minimize_rounds", 0) + 1
+            f.stats["minimize_batch_execs"] = f.stats.get(
+                "minimize_batch_execs", 0) + len(batch)
+        groups: Dict[int, list] = {}
+        for idx, job in batch:
+            groups.setdefault(self._home[idx], []).append((idx, job))
+
+        def run_env(env_idx: int, jobs):
+            out = []
+            for idx, (prog, stat, opts) in jobs:
+                try:
+                    infos = f.execute(prog, stat, opts, pid=env_idx,
+                                      scan_new=False)
+                except BaseException as e:  # noqa: BLE001
+                    out.append((idx, e))
+                else:
+                    out.append((idx, infos))
+            return out
+
+        results = []
+        if pool is None or len(groups) == 1:
+            for env_idx, jobs in groups.items():
+                results.extend(run_env(env_idx, jobs))
+        else:
+            for fu in [pool.submit(run_env, k, v)
+                       for k, v in groups.items()]:
+                results.extend(fu.result())
+        with self._cond:
+            self._results.update(results)
+            self._cond.notify_all()
 
 
 class _DevicePipeline:

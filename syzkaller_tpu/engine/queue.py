@@ -70,6 +70,20 @@ class WorkQueue:
                     return q.popleft()
         return None
 
+    def pop_triage_batch(self, n: int,
+                         from_candidate: bool = False) -> List[TriageItem]:
+        """Pop up to ``n`` more triage items from the SAME priority
+        class as an already-popped head item (batched-bisection
+        minimize, ISSUE 8): candidate-triage batches never mix with
+        plain triage, so the reference's priority ladder ordering is
+        preserved item-for-item."""
+        out: List[TriageItem] = []
+        with self._lock:
+            q = self._triage_candidate if from_candidate else self._triage
+            while q and len(out) < n:
+                out.append(q.popleft())
+        return out
+
     def depths(self):
         with self._lock:
             return {

@@ -461,6 +461,26 @@ class ManagerHttp:
             parts.append("<h2>prefix memoization</h2>"
                          + _table(["metric", "value"], pfx))
 
+        # fused signal path (ISSUE 8): cover merges through the fused
+        # merge+new entry vs silent host fallback off the pallas path,
+        # and the batched-bisection triage round economy.  fleet_*
+        # fallbacks carry the RPC deployment's remote engines
+        sig_rows = [[k, _fmt_num(snap[k])] for k in (
+            "cover_merge_fused_total", "pallas_cover_fallback_total",
+            "minimize_bisect_rounds_total", "fleet_minimize_rounds",
+            "minimize_batch_execs_total",
+            "fleet_minimize_batch_execs") if k in snap]
+        rounds = first_moving("minimize_bisect_rounds_total",
+                              "fleet_minimize_rounds")
+        bexecs = first_moving("minimize_batch_execs_total",
+                              "fleet_minimize_batch_execs")
+        if rounds:
+            sig_rows.append(["probe_execs_per_round",
+                             _fmt_num(round(bexecs / rounds, 2))])
+        if sig_rows:
+            parts.append("<h2>fused signal path</h2>"
+                         + _table(["metric", "value"], sig_rows))
+
         # drain_rows_dropped_total: rows the supervised drain gave up
         # on — silent loss must be VISIBLE here and in /stats.json
         # (fleet_drain_rows_dropped is the remote engines' wire stat)

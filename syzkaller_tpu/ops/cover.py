@@ -139,6 +139,26 @@ def bitset_or(a, b):
     return a | b
 
 
+# ---- word-level core (shared by the host entries, the fused XLA/pallas
+# merge, and the sharded mesh folds in parallel/mesh.py — ONE canonical
+# test/scatter so a mapping change can never fork the semantics) ----
+
+
+def bitset_test_words(bitset, word, bit):
+    """Gather-test pre-localized (word, bit) positions: True where the
+    bit is already set.  Callers mask invalid lanes themselves (their
+    ``word`` must still be in range — conventionally 0)."""
+    return ((bitset[word] >> bit) & U32(1)) == 1
+
+
+def bitset_or_words(bitset, word, bit, valid):
+    """Scatter-OR pre-localized (word, bit) positions into the set;
+    lanes with ``valid`` False are no-ops (their word index must still
+    be in range)."""
+    mask = jnp.where(valid, U32(1) << bit, U32(0))
+    return jnp.bitwise_or.at(bitset, word, mask, inplace=False)
+
+
 def signal_new(max_signal_bits, sigs):
     """Per batch row: any signal not yet in the accumulated set?
     sigs: [..., S] u32 padded with SENT."""
@@ -158,6 +178,219 @@ def signal_add(max_signal_bits, sigs):
 
 
 # ---------------------------------------------------------------------- #
+# Fused cover merge + new-signal testing (ISSUE 8).
+#
+# merge_and_new folds a BATCH of sparse per-program signal rows into the
+# accumulated bitset in one pass and reports, per row, how many distinct
+# bit positions the row introduced (the popcount delta) — the exact
+# semantics of scanning the rows sequentially with signal_new/signal_add
+# (cover.go:160-182 in a loop), without the [rows]-step sequential scan
+# or any [rows, nwords] dense materialization.  Counts are
+# SEQUENTIAL-PREFIX: a bit claimed by an earlier row in the batch (or
+# already in the accumulator) is not counted again, and in-row duplicate
+# values count once.  SENT lanes are padding (no-ops), matching
+# bitset_add/bitset_test.
+#
+# Three bit-identical implementations share these semantics:
+#   - merge_and_new_host: the numpy mirror (the engine's max-signal
+#     mirror fold and triage novelty screen run here — the accumulator
+#     lives in host memory);
+#   - _merge_and_new_xla: jit-safe sort-based XLA (safe under jit; the
+#     off-TPU production path);
+#   - pallas_cover.merge_and_new_pallas: the fused TPU kernel (VMEM-
+#     resident accumulator, one HBM read of the batch).
+# The eager entry dispatches pallas vs XLA through the measured-crossover
+# probe (pallas_cover.dispatch); under jit it is always the XLA core.
+
+
+_FUSED_COUNTER = None
+
+
+def _fused_counter():
+    global _FUSED_COUNTER
+    if _FUSED_COUNTER is None:
+        from ..telemetry import get_registry
+
+        _FUSED_COUNTER = get_registry().counter(
+            "cover_merge_fused_total",
+            help="fused cover merge + new-signal passes (host mirror, "
+                 "XLA, or pallas kernel)")
+    return _FUSED_COUNTER
+
+
+def merge_and_new(acc_bits, sigs):
+    """Fused batch fold: ``acc_bits`` [L] u32 packed bitset, ``sigs``
+    [N, S] u32 signal values padded with SENT.  Returns
+    ``(new_counts [N] i32, new_mask [N] bool, merged [L] u32)`` where
+    ``new_counts[i]`` is the number of distinct bit positions row i set
+    first (sequential-prefix popcount delta) and ``merged`` is
+    ``acc | OR(rows)``.  Jit-callable (XLA core under a trace); the
+    eager path dispatches to the fused pallas kernel through the
+    measured-crossover probe, and eager HOST inputs (numpy on a box
+    with no eligible pallas path) run the numpy mirror directly — the
+    same algebra without a device round-trip."""
+    if isinstance(acc_bits, jax.core.Tracer) or \
+            isinstance(sigs, jax.core.Tracer):
+        return _merge_and_new_xla(acc_bits, sigs)
+    from . import pallas_cover
+
+    host_in = isinstance(acc_bits, np.ndarray) and \
+        isinstance(sigs, np.ndarray)
+    acc_bits = jnp.asarray(acc_bits, U32) if not host_in else acc_bits
+    sigs = jnp.asarray(sigs, U32) if not host_in else \
+        np.asarray(sigs, np.uint32)
+    n, s = sigs.shape
+    if n == 0 or s == 0:
+        return (jnp.zeros((n,), jnp.int32), jnp.zeros((n,), bool),
+                acc_bits)
+    if host_in and not pallas_cover._eligible(acc_bits.shape[-1], n,
+                                              lanes=s):
+        # host-resident inputs off the accelerator: the numpy mirror IS
+        # the fused implementation (it's what the engine's fold and
+        # screen run); a jnp round-trip here would only add dispatch
+        # overhead on top of the same algebra
+        pallas_cover._fallback_counter().inc()
+        return merge_and_new_host(
+            np.array(acc_bits, dtype=np.uint32), sigs, update=True)
+    _fused_counter().inc()
+    return pallas_cover.dispatch(
+        "merge", acc_bits.shape[-1], n,
+        lambda: pallas_cover.merge_and_new_pallas(acc_bits, sigs),
+        lambda: _merge_and_new_xla(acc_bits, sigs),
+        lanes=s)
+
+
+def _merge_and_new_xla(acc_bits, sigs):
+    """Exact XLA implementation (safe under jit; pallas fallback).
+    Sequential-prefix counts come from a sort by (bit position, row):
+    the first valid occurrence of each position is charged to its row
+    iff the accumulator doesn't already hold it — O(NS log NS) batched
+    ops instead of an N-step sequential scan."""
+    U64 = jnp.uint64
+    acc = jnp.asarray(acc_bits, U32)
+    sigs = jnp.asarray(sigs, U32)
+    n, s = sigs.shape
+    if n == 0 or s == 0:
+        return jnp.zeros((n,), jnp.int32), jnp.zeros((n,), bool), acc
+    nbits = acc.shape[-1] * 32
+    flat = sigs.reshape(-1)
+    valid = flat != SENT
+    pos = flat & U32(nbits - 1)
+    rows = jnp.repeat(jnp.arange(n, dtype=U32), s)
+    key = jnp.where(valid,
+                    (pos.astype(U64) << U64(32)) | rows.astype(U64),
+                    U64(0xFFFFFFFFFFFFFFFF))
+    skey = jnp.sort(key)
+    svalid = skey != U64(0xFFFFFFFFFFFFFFFF)
+    spos = (skey >> U64(32)).astype(U32)
+    srow = (skey & U64(0xFFFFFFFF)).astype(jnp.int32)
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), spos[1:] != spos[:-1]])
+    word = jnp.where(svalid, (spos >> 5).astype(jnp.int32), 0)
+    known = bitset_test_words(acc, word, spos & U32(31))
+    newbit = svalid & first & ~known
+    counts = jax.ops.segment_sum(
+        newbit.astype(jnp.int32), jnp.where(svalid, srow, n),
+        num_segments=n + 1)[:n]
+    merged = bitset_add(acc, flat)
+    return counts, counts > 0, merged
+
+
+# claim-table strategy ceiling: below this the first-occurrence dedup
+# uses an O(nbits) scratch table (no sort at all) — the big-batch fast
+# path; above it (the engine's 2^26 mirrors) the scratch would be
+# hundreds of MB, so the sort path runs (its batches are small)
+CLAIM_TABLE_MAX_BITS = 1 << 24
+# the claim table only pays for itself on big batches; tiny scans
+# (the drain's per-execution novelty screen) stay on the sort path
+CLAIM_TABLE_MIN_ELEMS = 1 << 12
+
+
+def merge_and_new_host(acc, sigs, update=False):
+    """Bit-identical numpy mirror of ``merge_and_new`` over a HOST
+    accumulator.  ``update=True`` ORs the new bits into ``acc`` IN
+    PLACE and returns it (the engine's 8 MB max-signal mirror must not
+    copy per batch); ``update=False`` performs NO fold — the returned
+    accumulator is the input object untouched (the triage novelty
+    screen only wants the verdicts).
+
+    Two internal strategies, identical results: a sort by bit position
+    (stable, so the first occurrence keeps the lowest row), or — for
+    big batches over small-enough tables — a sort-FREE claim pass: an
+    uninitialized [nbits] scratch is fancy-stored in reverse flat
+    order, so each position ends up claimed by its first occurrence,
+    and the merged fold packs a bool plane instead of a scatter-OR."""
+    acc = np.asarray(acc)
+    sigs = np.asarray(sigs, dtype=np.uint32)
+    n = sigs.shape[0]
+    counts = np.zeros(n, dtype=np.int32)
+    _fused_counter().inc()
+    if not (n and sigs.shape[1]):
+        return counts, counts > 0, acc
+    nbits = acc.shape[-1] * 32
+    flat = sigs.reshape(-1)
+    keep = flat != np.uint32(0xFFFFFFFF)
+    if nbits <= CLAIM_TABLE_MAX_BITS and \
+            flat.size >= CLAIM_TABLE_MIN_ELEMS:
+        fidx = np.nonzero(keep)[0].astype(np.int64)
+        pos = (flat[fidx] & np.uint32(nbits - 1)).astype(np.int64)
+        # np.empty is deliberate: every position read back below was
+        # written by the reversed store (last write wins => the FIRST
+        # flat occurrence claims the position); untouched garbage is
+        # never read
+        claim = np.empty(nbits, dtype=np.int64)
+        claim[pos[::-1]] = fidx[::-1]
+        firstf = claim[pos] == fidx
+        pf = pos[firstf]
+        rowsf = fidx[firstf] // sigs.shape[1]
+        known = ((acc[pf >> 5] >> (pf & 31).astype(np.uint32))
+                 & np.uint32(1)).astype(bool)
+        counts += np.bincount(rowsf[~known],
+                              minlength=n).astype(np.int32)
+        if update and pf.size:
+            plane = np.zeros(nbits, dtype=bool)
+            plane[pf] = True
+            acc |= np.packbits(plane, bitorder="little").view(np.uint32)
+        return counts, counts > 0, acc
+    pos = (flat & np.uint32(nbits - 1))[keep].astype(np.int64)
+    rows = np.repeat(np.arange(n, dtype=np.int64),
+                     sigs.shape[1])[keep]
+    # stable single-key sort: rows already ascend in flat order, so
+    # the first element of each equal-position run has the lowest row
+    order = np.argsort(pos, kind="stable")
+    ps, rs = pos[order], rows[order]
+    first = np.ones(ps.size, dtype=bool)
+    first[1:] = ps[1:] != ps[:-1]
+    word = ps >> 5
+    bit = (ps & 31).astype(np.uint32)
+    known = ((acc[word] >> bit) & np.uint32(1)).astype(bool)
+    newbit = first & ~known
+    np.add.at(counts, rs[newbit], 1)
+    if update:
+        np.bitwise_or.at(acc, word[first],
+                         np.uint32(1) << bit[first])
+    return counts, counts > 0, acc
+
+
+def bitset_add_host(bits, values) -> None:
+    """In-place host scatter-OR of signal VALUES into a numpy packed
+    bitset (the numpy twin of ``bitset_add``; values wrap to u32, exact
+    SENT is a no-op like the device ops)."""
+    v = np.asarray(list(values), dtype=np.uint64) if not \
+        isinstance(values, np.ndarray) else values.astype(np.uint64)
+    if v.size == 0:
+        return
+    vv = (v & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    vv = vv[vv != np.uint32(0xFFFFFFFF)]
+    if vv.size == 0:
+        return
+    nbits = bits.shape[-1] * 32
+    pos = (vv & np.uint32(nbits - 1)).astype(np.int64)
+    np.bitwise_or.at(bits, pos >> 5,
+                     np.uint32(1) << (pos & 31).astype(np.uint32))
+
+
+# ---------------------------------------------------------------------- #
 # Corpus minimization: greedy set cover (cover.go:119-146), device version
 # over per-program bitsets.
 
@@ -167,11 +400,12 @@ def minimize_corpus(program_bits, sizes=None):
     Returns keep mask [N] bool — the greedy cover: programs in decreasing
     coverage-size order, kept iff they add an uncovered bit.
 
-    Dispatches to the pallas kernel (ops/pallas_cover.py) on TPU when the
-    bitset fits VMEM; this function is the exact XLA-scan semantics both
-    share.  Call _minimize_corpus_xla directly from inside jit (the pallas
-    wrapper is eager).  The eager entry is span-timed (``cover.minimize``)
-    — corpus minimization is a triage-ladder phase the manager graphs."""
+    Dispatches to the pallas kernel (ops/pallas_cover.py) through the
+    measured-crossover probe when the bitset fits VMEM; this function is
+    the exact XLA-scan semantics both share.  Call _minimize_corpus_xla
+    directly from inside jit (the pallas wrapper is eager).  The eager
+    entry is span-timed (``cover.minimize``) — corpus minimization is a
+    triage-ladder phase the manager graphs."""
     if not isinstance(program_bits, jax.core.Tracer):
         from . import pallas_cover
         from ..telemetry import get_tracer
@@ -183,10 +417,10 @@ def minimize_corpus(program_bits, sizes=None):
         # caller's
         tracer = get_tracer()
         with tracer.span("cover.minimize"):
-            if pallas_cover._use_pallas(pb.shape[-1], pb.shape[0]):
-                out = pallas_cover._minimize_pallas_entry(pb, sizes)
-            else:
-                out = _minimize_corpus_xla(program_bits, sizes)
+            out = pallas_cover.dispatch(
+                "minimize", pb.shape[-1], pb.shape[0],
+                lambda: pallas_cover._minimize_pallas_entry(pb, sizes),
+                lambda: _minimize_corpus_xla(program_bits, sizes))
             if tracer.enabled:
                 jax.block_until_ready(out)
         return out

@@ -1,6 +1,6 @@
 """Pallas TPU kernels for the coverage-bitset hot paths.
 
-Two ops from ops/cover.py dominate the triage loop (reference pkg/cover:
+Two ops from ops/cover.py dominate the signal path (reference pkg/cover:
 greedy corpus Minimize, cover.go:119-146, and the SignalNew/SignalAdd hot
 path, cover.go:104-182):
 
@@ -11,21 +11,35 @@ path, cover.go:104-182):
   across the sequential TPU grid, so each step reads one program's bits
   from HBM and nothing else.
 
-- ``signal_stats``: fold a batch of per-program bitsets into the
-  accumulated set and count each program's new bits in the same pass —
-  one HBM read of the batch instead of XLA's separate popcount/OR sweeps.
+- ``merge_and_new_pallas``: the FUSED cover merge + new-signal test
+  (ISSUE 8).  One pass over the batch's sparse signal rows: the
+  accumulator bitset is copied into VMEM once, each row's signals are
+  test-and-set against it scalar-wise (per-row popcount-delta novelty
+  counts fall out of the test), and the merged accumulator is emitted at
+  the end — no per-row [rows, nwords] dense bitsets, no second sweep.
+  This replaces the retired ``signal_stats`` kernel, which required the
+  caller to materialize a dense [rows, nwords] bitset per program and
+  round-tripped the accumulator through HBM per stage; the engine's
+  signal fold never called it (ISSUE 8 satellite: wire or retire — the
+  fused entry is the wired replacement, cover.merge_and_new).
 
-Both kernels view the [L]-word bitset as [R, 128] u32 tiles (VPU lane
+The kernels view the [L]-word bitset as [R, 128] u32 tiles (VPU lane
 width; R padded to the 8-sublane int32 tile).  They require the full
-bitset to fit in VMEM (≤ MAX_VMEM_WORDS per buffer) — the wrappers fall
-back to the exact jnp implementations above that size or off-TPU, and
-run the same kernel in interpreter mode under tests (conftest forces
-JAX_PLATFORMS=cpu).
+bitset to fit in VMEM (≤ MAX_VMEM_WORDS per buffer).
+
+Dispatch is a MEASURED crossover, not a size guess: the first eager call
+per (op, size-bucket) times the pallas kernel against the exact XLA
+implementation (after a warm-up call each, so compile time doesn't vote)
+and caches the winner for the process — ``dispatch()``.  Every dispatch
+that does NOT take the pallas path (off-TPU, over-size, or probe-lost)
+counts ``pallas_cover_fallback_total`` so silent host fallback is
+visible on /dashboard.
 """
 
 from __future__ import annotations
 
 import os
+import time
 
 import jax
 import jax.numpy as jnp
@@ -46,6 +60,9 @@ MAX_VMEM_WORDS = 1 << 20
 # Per-program scalars (hit flags / new-bit counts) live in one full-array
 # SMEM block written at program_id; SMEM is small, so cap the batch.
 MAX_SMEM_ROWS = 4096
+# The fused merge kernel reads each row's sparse signals from an SMEM
+# block scalar-by-scalar; cap the per-row lane count the same way.
+MAX_SMEM_LANES = 4096
 
 
 def _tile(bits):
@@ -68,11 +85,95 @@ def _tile(bits):
 _INTERPRET = os.environ.get("SYZTPU_PALLAS_INTERPRET", "") == "1"
 
 
-def _use_pallas(nwords: int, nrows: int) -> bool:
-    if nwords > MAX_VMEM_WORDS or nrows > MAX_SMEM_ROWS:
-        return False
-    return jax.devices()[0].platform == "tpu" or _INTERPRET
+# ---------------------------------------------------------------------- #
+# measured-crossover dispatch (replaces the old _use_pallas size guess)
 
+# (op, log2-bucketed nwords, log2-bucketed nrows) -> use pallas?  One
+# probe per bucket per process: both paths run once to warm (compile),
+# once timed, and the winner is cached.  crossover_reset() clears it
+# (tests, or after a driver restart changes kernel perf).
+_CROSSOVER: dict = {}
+
+_FALLBACKS = None
+
+
+def _fallback_counter():
+    global _FALLBACKS
+    if _FALLBACKS is None:
+        from ..telemetry import get_registry
+
+        _FALLBACKS = get_registry().counter(
+            "pallas_cover_fallback_total",
+            help="cover-kernel dispatches that fell back off the pallas "
+                 "path (off-TPU, bitset over VMEM budget, or the "
+                 "measured crossover chose XLA)")
+    return _FALLBACKS
+
+
+def crossover_reset() -> None:
+    """Drop the per-process measured-crossover cache (test hook)."""
+    _CROSSOVER.clear()
+
+
+def _platform() -> str:
+    return jax.devices()[0].platform
+
+
+def _eligible(nwords: int, nrows: int, lanes: int = 0) -> bool:
+    """Hard constraints only (VMEM/SMEM budgets + a TPU or the
+    interpreter); which path is FASTER is the probe's question."""
+    if nwords > MAX_VMEM_WORDS or nrows > MAX_SMEM_ROWS \
+            or lanes > MAX_SMEM_LANES:
+        return False
+    return _platform() == "tpu" or _INTERPRET
+
+
+def dispatch(op: str, nwords: int, nrows: int, pallas_fn, xla_fn,
+             lanes: int = 0):
+    """Run ``pallas_fn`` or ``xla_fn`` (both thunks returning the same
+    bit-identical result) — pallas when eligible AND measured faster.
+
+    Under the test interpreter the pallas path always runs (the
+    interpreter exists to exercise kernel logic, not to win races).  On
+    a TPU the first call per (op, size-bucket) warms and times both
+    paths and caches the winner for the process.  Every non-pallas
+    dispatch counts ``pallas_cover_fallback_total``."""
+    if not _eligible(nwords, nrows, lanes):
+        _fallback_counter().inc()
+        return xla_fn()
+    if _INTERPRET:
+        return pallas_fn()
+    # lanes is a cost axis of its own (the merge kernel's per-row loop
+    # is linear in it while the XLA sort is in n*s) — a winner measured
+    # at s=8 must not get locked in for s=4096
+    key = (op, max(int(nwords), 1).bit_length(),
+           max(int(nrows), 1).bit_length(),
+           max(int(lanes), 1).bit_length())
+    use = _CROSSOVER.get(key)
+    if use is None:
+        # one-shot measured crossover: warm both (compile), time both,
+        # keep the winner.  The probe's own work isn't wasted — the
+        # timed pallas result is returned when it wins.
+        jax.block_until_ready(pallas_fn())
+        jax.block_until_ready(xla_fn())
+        t0 = time.perf_counter()
+        out_p = jax.block_until_ready(pallas_fn())
+        tp = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        out_x = jax.block_until_ready(xla_fn())
+        tx = time.perf_counter() - t0
+        _CROSSOVER[key] = use = tp <= tx
+        if not use:
+            _fallback_counter().inc()
+        return out_p if use else out_x
+    if not use:
+        _fallback_counter().inc()
+        return xla_fn()
+    return pallas_fn()
+
+
+# ---------------------------------------------------------------------- #
+# greedy corpus minimize
 
 def _minimize_kernel(bits_ref, hit_ref, covered_ref):
     i = pl.program_id(0)
@@ -114,7 +215,7 @@ def _minimize_pallas(tiles):
 
 
 def _minimize_pallas_entry(program_bits, sizes=None):
-    """Pallas-only path; caller has already checked _use_pallas."""
+    """Pallas-only path; caller has already checked eligibility."""
     from . import cover as _cover
 
     program_bits = jnp.asarray(program_bits, U32)
@@ -131,80 +232,97 @@ def minimize_corpus(program_bits, sizes=None):
     """Greedy set-cover keep-mask over per-program packed bitsets.
 
     Drop-in for ops.cover.minimize_corpus ([N, L] u32 -> [N] bool) with
-    identical semantics; dispatches to the pallas kernel when the bitset
-    fits VMEM, else to the exact XLA scan.  ops.cover.minimize_corpus is
-    the production entry point and routes here on TPU."""
+    identical semantics; dispatches to the pallas kernel through the
+    measured-crossover probe, else to the exact XLA scan.
+    ops.cover.minimize_corpus is the production entry point and routes
+    here on TPU."""
     from . import cover as _cover
 
     program_bits = jnp.asarray(program_bits, U32)
     n, l = program_bits.shape
-    if not _use_pallas(l, n):
-        return _cover._minimize_corpus_xla(program_bits, sizes)
-    return _minimize_pallas_entry(program_bits, sizes)
+    return dispatch(
+        "minimize", l, n,
+        lambda: _minimize_pallas_entry(program_bits, sizes),
+        lambda: _cover._minimize_corpus_xla(program_bits, sizes))
 
 
-def _stats_kernel(acc_ref, bits_ref, count_ref, merged_ref):
+# ---------------------------------------------------------------------- #
+# fused cover merge + new-signal test (ISSUE 8 tentpole)
+
+def _merge_kernel(mask, sig_ref, acc_ref, count_ref, merged_ref):
+    """One grid step per signal row: test-and-set this row's sparse
+    signal positions against the VMEM-resident accumulator.  The
+    novelty count is the popcount delta — each scalar test that finds
+    its bit clear adds one — and in-row duplicates count once because
+    the bit is set the instant it is first seen.  The accumulator is
+    copied from the input ONCE (step 0) and emitted as the merged
+    output; no per-row dense bitset ever exists."""
     i = pl.program_id(0)
-
-    bits = bits_ref[0]
-    fresh = bits & ~acc_ref[:]
-    pops = jax.lax.convert_element_type(
-        jax.lax.population_count(fresh), jnp.int32)
-    count_ref[i] = jnp.sum(pops, dtype=jnp.int32)
 
     @pl.when(i == 0)
     def _():
         merged_ref[:] = acc_ref[:]
 
-    merged_ref[:] = merged_ref[:] | bits
+    s = sig_ref.shape[1]
+
+    def body(j, count):
+        v = sig_ref[0, j]
+        valid = v != U32(0xFFFFFFFF)
+        pos = v & U32(mask)
+        word = pos >> U32(5)
+        r = jax.lax.convert_element_type(word >> U32(7), jnp.int32)
+        c = jax.lax.convert_element_type(word & U32(127), jnp.int32)
+        bit = pos & U32(31)
+        cur = merged_ref[r, c]
+        m = U32(1) << bit
+        new = valid & ((cur & m) == U32(0))
+
+        @pl.when(valid)
+        def _():
+            merged_ref[r, c] = cur | m
+
+        return count + jax.lax.convert_element_type(new, jnp.int32)
+
+    count_ref[i] = jax.lax.fori_loop(0, s, body, jnp.int32(0))
 
 
-def _stats_pallas(acc_tiles, tiles):
-    n, r, _ = tiles.shape
+def _merge_pallas(acc_tiles, sigs, nbits: int):
+    from functools import partial
+
+    n, s = sigs.shape
+    r = acc_tiles.shape[0]
     with x64_context(False):
         counts, merged = pl.pallas_call(
-        _stats_kernel,
-        grid=(n,),
-        in_specs=[
-            pl.BlockSpec((r, LANES), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, r, LANES), lambda i: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=[
-            pl.BlockSpec((n,), lambda i: (0,),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((r, LANES), lambda i: (0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((n,), jnp.int32),
-            jax.ShapeDtypeStruct((r, LANES), U32),
-        ],
+            partial(_merge_kernel, nbits - 1),
+            grid=(n,),
+            in_specs=[
+                pl.BlockSpec((1, s), lambda i: (i, 0),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((r, LANES), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((n,), lambda i: (0,),
+                             memory_space=pltpu.SMEM),
+                pl.BlockSpec((r, LANES), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((n,), jnp.int32),
+                jax.ShapeDtypeStruct((r, LANES), U32),
+            ],
             interpret=_INTERPRET,
-        )(acc_tiles, tiles)
+        )(sigs, acc_tiles)
     return counts, merged
 
 
-def signal_stats(acc_bits, program_bits):
-    """One-pass fold + new-bit counting.
-
-    acc_bits: [L] u32 accumulated max-signal bitset.
-    program_bits: [N, L] u32 per-program signal bitsets.
-    Returns (new_counts [N] int32 — bits of each program absent from
-    acc_bits — and merged [L] u32 = acc | OR(programs))."""
-    from . import cover as _cover
-
+def merge_and_new_pallas(acc_bits, sigs):
+    """Pallas-only fused merge + new-signal test; same contract as
+    ops.cover.merge_and_new (which is the dispatching entry point).
+    Caller has already checked eligibility and non-empty shapes."""
     acc_bits = jnp.asarray(acc_bits, U32)
-    program_bits = jnp.asarray(program_bits, U32)
-    n, l = program_bits.shape
-    if not _use_pallas(l, n):
-        fresh = program_bits & ~acc_bits[None, :]
-        counts = jax.vmap(_cover.bitset_count)(fresh).astype(jnp.int32)
-        merged = acc_bits | jax.lax.reduce(
-            program_bits, np.uint32(0), jax.lax.bitwise_or, (0,))
-        return counts, merged
+    sigs = jnp.asarray(sigs, U32)
+    l = acc_bits.shape[-1]
     acc_tiles, _ = _tile(acc_bits)
-    tiles, _ = _tile(program_bits)
-    counts, merged_tiles = _stats_pallas(acc_tiles, tiles)
-    return counts, merged_tiles.reshape(-1)[:l]
+    counts, merged_tiles = _merge_pallas(acc_tiles, sigs, nbits=l * 32)
+    return counts, counts > 0, merged_tiles.reshape(-1)[:l]

@@ -33,6 +33,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.dtables import DeviceTables
 from ..ops import admission as dadm
+from ..ops import cover as dcov
 from ..ops import mutation as dmut
 from ..ops import rng as drng
 from ..telemetry import get_registry, get_tracer
@@ -211,9 +212,12 @@ def fold_signals(sig_shard, sigs, gate=None):
     filter decays, an identical mutant re-tests fresh and executes."""
     j = jax.lax.axis_index(AXIS_COVER)
     n_shards = jax.lax.psum(1, AXIS_COVER)
-    # --- test: per-shard hits, then combine over the cover axis ---
+    # --- test: per-shard hits, then combine over the cover axis (the
+    # word-level test/scatter core is ops/cover's — the same one the
+    # fused merge_and_new path uses, so the bitset semantics can never
+    # fork between the sharded step and the host/XLA/pallas merges) ---
     mine, lw, bit = _shard_index(sig_shard, sigs, j, n_shards)
-    hit = ((sig_shard[lw] >> bit) & U32(1)) == 1
+    hit = dcov.bitset_test_words(sig_shard, lw, bit)
     fresh_local = jnp.any(mine & ~hit, axis=-1)
     fresh = jax.lax.psum(fresh_local.astype(jnp.int32), AXIS_COVER) > 0
     # --- fold: gather every fuzz-shard's signals, scatter my range ---
@@ -221,8 +225,7 @@ def fold_signals(sig_shard, sigs, gate=None):
         sigs = jnp.where(gate[..., None], jnp.asarray(sigs, U32), SENT)
     allsigs = jax.lax.all_gather(sigs, AXIS_FUZZ).reshape(-1)
     mine_all, lw_all, bit_all = _shard_index(sig_shard, allsigs, j, n_shards)
-    mask = jnp.where(mine_all, U32(1) << bit_all, U32(0))
-    sig_shard = jnp.bitwise_or.at(sig_shard, lw_all, mask, inplace=False)
+    sig_shard = dcov.bitset_or_words(sig_shard, lw_all, bit_all, mine_all)
     return sig_shard, fresh
 
 
@@ -238,15 +241,14 @@ def fold_admission(bloom_shard, probes):
     n_shards = jax.lax.psum(1, AXIS_COVER)
     # --- test: any probe I own that is NOT set refutes membership ---
     mine, lw, bit = _shard_index(bloom_shard, probes, j, n_shards)
-    hit = ((bloom_shard[lw] >> bit) & U32(1)) == 1
+    hit = dcov.bitset_test_words(bloom_shard, lw, bit)
     missing_local = jnp.any(mine & ~hit, axis=-1)
     seen = jax.lax.psum(missing_local.astype(jnp.int32), AXIS_COVER) == 0
     # --- fold: gather every fuzz-shard's probes, scatter my range ---
     allp = jax.lax.all_gather(probes, AXIS_FUZZ).reshape(-1)
     mine_all, lw_all, bit_all = _shard_index(bloom_shard, allp, j, n_shards)
-    mask = jnp.where(mine_all, U32(1) << bit_all, U32(0))
-    bloom_shard = jnp.bitwise_or.at(bloom_shard, lw_all, mask,
-                                    inplace=False)
+    bloom_shard = dcov.bitset_or_words(bloom_shard, lw_all, bit_all,
+                                       mine_all)
     return bloom_shard, seen
 
 

@@ -81,6 +81,14 @@ REQUIRED_METRICS = (
     "rpc_retries_total",
     "device_degraded_total",
     "errors_total",
+    # fused signal path (ISSUE 8): silent host fallback off the pallas
+    # cover kernels must stay visible, fused merges must stay
+    # countable, and the batched-bisection round economy must stay
+    # auditable next to the probe execs it carries
+    "pallas_cover_fallback_total",
+    "cover_merge_fused_total",
+    "minimize_bisect_rounds_total",
+    "minimize_batch_execs_total",
     # fleet observability (ISSUE 7): the durable campaign journal's
     # volume must stay visible (record/byte growth is the replay-cost
     # axis), and the fleet aggregator's scrape health must never go

@@ -350,3 +350,199 @@ def test_device_raw_path_feeds_triage(target):
             ExecOpts(), batch.streams[row], batch.call_ids(row))
         assert not failed and not hanged
         assert infos and infos[0].executed
+
+
+# ---- batched-bisection triage minimize (ISSUE 8) ----
+
+
+def _drain_all(f):
+    while any(v for v in f.queue.depths().values()):
+        f.step()
+
+
+def _seed_and_drain(target, bisect, procs, seed=7, n_progs=5, length=8):
+    cfg = FuzzerConfig(mock=True, use_device=False, procs=procs,
+                       program_length=length, smash_mutations=0,
+                       minimize_bisect=bisect)
+    with Fuzzer(target, cfg, seed=seed) as f:
+        for i in range(n_progs):
+            f.execute(generate(target, 500 + i, length), "exec_fuzz")
+        _drain_all(f)
+        return sorted(serialize(p) for p in f.corpus), dict(f.stats)
+
+
+def test_bisect_minimize_byte_identical_to_sequential(target):
+    """ACCEPTANCE (ISSUE 8): the batched-bisection scheduler produces
+    the exact same minimized programs (and exec totals) as the
+    sequential one-probe-per-round-trip path on a seeded corpus — the
+    per-item minimize ladder is the same code, only the execution
+    schedule changes."""
+    c_seq, s_seq = _seed_and_drain(target, bisect=False, procs=1)
+    c_bis, s_bis = _seed_and_drain(target, bisect=True, procs=1)
+    assert c_seq == c_bis
+    assert s_seq["exec_total"] == s_bis["exec_total"]
+    assert s_seq["new_inputs"] == s_bis["new_inputs"]
+    # the win surface: probes collapsed into far fewer serial rounds
+    probes = s_bis["exec_triage"] + s_bis["exec_minimize"]
+    assert 0 < s_bis["minimize_rounds"] < probes
+    assert s_bis["minimize_batch_execs"] <= probes
+    assert "minimize_rounds" not in s_seq
+
+
+def test_bisect_minimize_multi_env_fleet(target):
+    """At procs=4 the rounds fan across the fleet with each item pinned
+    to a home env; the minimized corpus still matches the sequential
+    path (MockEnv signal is a pure per-call function, so an internally
+    consistent env assignment preserves every verdict)."""
+    c_seq, _s = _seed_and_drain(target, bisect=False, procs=4)
+    c_bis, s_bis = _seed_and_drain(target, bisect=True, procs=4)
+    assert c_seq == c_bis
+    assert s_bis["minimize_rounds"] > 0
+
+
+def test_bisect_rounds_batch_probes(target):
+    """One round carries one probe from every still-active item: with
+    N items queued, rounds < total probes and the per-round batch size
+    starts at N."""
+    from syzkaller_tpu.engine.fuzzer import _BisectRounds
+
+    cfg = FuzzerConfig(mock=True, use_device=False, procs=2,
+                       program_length=6, smash_mutations=0)
+    with Fuzzer(target, cfg) as f:
+        items = []
+        for i in range(3):
+            f.execute(generate(target, 700 + i, 6), "exec_fuzz")
+        while (item := f.queue.pop()) is not None:
+            if isinstance(item, TriageItem):
+                items.append(item)
+        assert len(items) >= 2
+        items = items[:3]
+        outs = _BisectRounds(f, items).run()
+        assert len(outs) == len(items)
+        assert any(o is not None for o in outs)
+        rounds = f.stats["minimize_rounds"]
+        execs = f.stats["minimize_batch_execs"]
+        assert rounds < execs  # batching happened
+        # every item is pinned to a home env for its whole ladder
+        assert f.stats["exec_triage"] >= len(items) * f.cfg.triage_reruns
+
+
+def test_step_pops_triage_batch(target):
+    """step() drains the whole triage class into one batched call when
+    minimize_bisect is on, and one item at a time when off."""
+    cfg = FuzzerConfig(mock=True, use_device=False, procs=1,
+                       program_length=6, smash_mutations=0,
+                       minimize_bisect=True, minimize_batch=8)
+    with Fuzzer(target, cfg) as f:
+        for i in range(3):
+            f.execute(generate(target, 800 + i, 6), "exec_fuzz")
+        depth = f.queue.depths()["triage"]
+        assert depth >= 2
+        f.step()  # one step consumes the whole class (<= minimize_batch)
+        assert f.queue.depths()["triage"] == max(depth - 8, 0)
+
+
+# ---- fused triage novelty screen (ISSUE 8) ----
+
+
+def test_scan_infos_fused_screen(target):
+    """The drain's novelty scan screens calls through the max-signal
+    bitset image: known signal enqueues nothing, novel signal still
+    triages, and within one execution a later call whose novelty is
+    fully claimed by an earlier call defers to it (first-claim)."""
+    from syzkaller_tpu.ipc import CallInfo
+
+    class _FakeBatch:
+        def __init__(self, prog):
+            self.prog = prog
+
+        def decode(self, row):
+            return self.prog
+
+    cfg = FuzzerConfig(mock=True, use_device=True, procs=1,
+                       smash_mutations=0)
+    with Fuzzer(target, cfg) as f:
+        if f._tri_bits is None:
+            pytest.skip("no device pipeline (jax unavailable)")
+        p = generate(target, 42, 4)
+        infos = [
+            CallInfo(index=0, num=0, errno=0, executed=True,
+                     fault_injected=False, signal=[11111, 22222],
+                     cover=[], comps=[]),
+            CallInfo(index=1, num=0, errno=0, executed=True,
+                     fault_injected=False, signal=[11111],
+                     cover=[], comps=[]),
+        ]
+        from syzkaller_tpu.telemetry import Provenance
+
+        origin = Provenance("mutate")
+        ok = f._scan_infos_for_triage(_FakeBatch(p), 0, infos, origin)
+        assert ok
+        items = []
+        while (it := f.queue.pop()) is not None:
+            items.append(it)
+        triaged = [i for i in items if isinstance(i, TriageItem)]
+        # call 0 claims both PCs; call 1's novelty is fully claimed
+        assert [t.call_index for t in triaged] == [0]
+        # once the signal is in max_signal (screen noted), nothing new
+        f._note_signal([11111, 22222])
+        ok = f._scan_infos_for_triage(_FakeBatch(p), 0, infos, origin)
+        assert ok
+        assert f.queue.pop() is None
+
+
+def test_screen_mirrors_max_signal_superset(target):
+    """Every max_signal growth site must set the member's screen bit —
+    the soundness invariant (clear bit => definitely new)."""
+    import numpy as np
+
+    cfg = FuzzerConfig(mock=True, use_device=True, procs=1,
+                       smash_mutations=0)
+    with Fuzzer(target, cfg) as f:
+        if f._tri_bits is None:
+            pytest.skip("no device pipeline (jax unavailable)")
+        f._note_signal([12345, 67890])
+        nbits = f._tri_bits.shape[0] * 32
+        for s in f.max_signal:
+            pos = s & (nbits - 1)
+            assert (f._tri_bits[pos >> 5] >> (pos & 31)) & 1
+
+
+def test_screen_never_drops_sent_wrapping_signal(target):
+    """A signal value that wraps to the SENT sentinel (0xFFFFFFFF) is
+    invisible to the packed screen — such calls must take the exact
+    path, not be silently screened out."""
+    from syzkaller_tpu.ipc import CallInfo
+    from syzkaller_tpu.telemetry import Provenance
+
+    class _FakeBatch:
+        def __init__(self, prog):
+            self.prog = prog
+
+        def decode(self, row):
+            return self.prog
+
+    cfg = FuzzerConfig(mock=True, use_device=True, procs=1,
+                       smash_mutations=0)
+    with Fuzzer(target, cfg) as f:
+        if f._tri_bits is None:
+            pytest.skip("no device pipeline (jax unavailable)")
+        p = generate(target, 43, 4)
+        infos = [
+            CallInfo(index=0, num=0, errno=0, executed=True,
+                     fault_injected=False, signal=[0xFFFFFFFF],
+                     cover=[], comps=[]),
+            CallInfo(index=1, num=0, errno=0, executed=True,
+                     fault_injected=False, signal=[0xFFFFFFFF],
+                     cover=[], comps=[]),
+        ]
+        f._scan_infos_for_triage(_FakeBatch(p), 0, infos,
+                                 Provenance("mutate"))
+        items = []
+        while (it := f.queue.pop()) is not None:
+            if isinstance(it, TriageItem):
+                items.append(it)
+        # both calls carry the unscreenable value and max_signal does
+        # not contain it: the exact diff must have triaged BOTH (the
+        # screen may not first-claim what it cannot see)
+        assert [t.call_index for t in items] == [0, 1]

@@ -119,3 +119,125 @@ def test_exact_ops_batched():
         bi = [int(v) for v in Bm[i] if v != 0xFFFFFFFF]
         assert bool(hd[i]) == cover.py_has_difference(ai, bi)
     assert cover.set_size(cover.union(A, Bm)).shape == (4,)
+
+
+# ---- fused merge + new-signal entry (ISSUE 8) ----
+
+
+def _py_sequential_fold(acc, sigs):
+    """Direct python reimplementation of the merge_and_new contract:
+    fold the rows one at a time into an exact bit-position set."""
+    nbits = acc.shape[0] * 32
+    covered = set()
+    for w in range(acc.shape[0]):
+        v = int(acc[w])
+        b = 0
+        while v:
+            if v & 1:
+                covered.add(w * 32 + b)
+            v >>= 1
+            b += 1
+    counts = []
+    for row in sigs:
+        fresh = set()
+        for v in row:
+            v = int(v)
+            if v == 0xFFFFFFFF:
+                continue
+            p = v & (nbits - 1)
+            if p not in covered:
+                fresh.add(p)
+        covered |= fresh
+        counts.append(len(fresh))
+    merged = np.zeros_like(acc)
+    for p in covered:
+        merged[p >> 5] |= np.uint32(1) << np.uint32(p & 31)
+    return counts, merged
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_merge_and_new_matches_python_reference(seed):
+    rng = np.random.default_rng(seed)
+    acc = rng.integers(0, 1 << 32, size=64, dtype=np.uint32) & \
+        rng.integers(0, 1 << 32, size=64, dtype=np.uint32)
+    sigs = rng.integers(0, 1 << 32, size=(8, 6), dtype=np.uint32)
+    sigs[rng.random(sigs.shape) < 0.2] = 0xFFFFFFFF
+    ref_counts, ref_merged = _py_sequential_fold(acc, sigs)
+    counts, mask, merged = cover.merge_and_new_host(
+        acc.copy(), sigs, update=True)
+    assert list(counts) == ref_counts
+    assert list(mask) == [c > 0 for c in ref_counts]
+    np.testing.assert_array_equal(merged, ref_merged)
+    jc, jm, jmerged = cover.merge_and_new(acc, sigs)
+    assert list(np.asarray(jc)) == ref_counts
+    np.testing.assert_array_equal(np.asarray(jmerged), ref_merged)
+
+
+def test_merge_and_new_host_update_semantics():
+    """update=True mutates the accumulator IN PLACE and returns it;
+    update=False performs no fold and returns the input untouched."""
+    acc = np.zeros(32, np.uint32)
+    sigs = np.array([[3, 70]], dtype=np.uint32)
+    counts, mask, out = cover.merge_and_new_host(acc, sigs)
+    assert out is acc and not acc.any()          # screen mode: no fold
+    assert counts[0] == 2 and mask[0]
+    counts, mask, out = cover.merge_and_new_host(acc, sigs, update=True)
+    assert out is acc and acc.any()              # folded in place
+    counts2, _, _ = cover.merge_and_new_host(acc, sigs)
+    assert counts2[0] == 0                       # now known
+
+
+def test_merge_and_new_jit_callable():
+    """The entry is safe under jit (the XLA core traces)."""
+    acc = np.zeros(64, np.uint32)
+    sigs = np.array([[1, 2], [1, 0xFFFFFFFF]], dtype=np.uint32)
+    jitted = jax.jit(cover.merge_and_new)
+    counts, mask, merged = jitted(acc, sigs)
+    # row 1's only real signal is claimed by row 0 (sequential-prefix)
+    assert list(np.asarray(counts)) == [2, 0]
+    hc, _, hmerged = cover.merge_and_new_host(acc.copy(), sigs,
+                                              update=True)
+    np.testing.assert_array_equal(np.asarray(merged), hmerged)
+
+
+def test_bitset_add_host_matches_device_add():
+    values = [5, 1 << 20, 0xFFFFFFFF, 123456789, 5]
+    host = np.zeros(1 << 10, np.uint32)
+    cover.bitset_add_host(host, values)
+    dev = cover.bitset_add(cover.make_bitset(32 << 10),
+                           np.asarray(values, np.uint32))
+    np.testing.assert_array_equal(host, np.asarray(dev))
+
+
+def test_bitset_word_helpers_roundtrip():
+    """The shared word-level core (also used by the mesh folds): OR'd
+    positions test back as set, invalid lanes are no-ops."""
+    import jax.numpy as jnp
+
+    bits = jnp.zeros(16, jnp.uint32)
+    word = jnp.array([0, 3, 3, 0], jnp.int32)
+    bit = jnp.array([1, 5, 9, 1], jnp.uint32)
+    valid = jnp.array([True, True, False, True])
+    bits = cover.bitset_or_words(bits, word, bit, valid)
+    hit = cover.bitset_test_words(bits, word, bit)
+    assert list(np.asarray(hit)) == [True, True, False, True]
+    assert int(np.asarray(bits)[3]) == 1 << 5
+
+
+def test_merge_and_new_host_strategies_identical(monkeypatch):
+    """The sort-free claim-table strategy (big batch, small table) and
+    the stable-sort strategy must be bit-identical — same counts, same
+    folded accumulator."""
+    rng = np.random.default_rng(9)
+    acc = rng.integers(0, 1 << 32, size=1 << 10, dtype=np.uint32) & \
+        rng.integers(0, 1 << 32, size=1 << 10, dtype=np.uint32)
+    sigs = rng.integers(0, 1 << 32, size=(40, 16), dtype=np.uint32)
+    sigs[rng.random(sigs.shape) < 0.2] = 0xFFFFFFFF
+    sigs[1] = sigs[0]
+    a1, a2 = acc.copy(), acc.copy()
+    monkeypatch.setattr(cover, "CLAIM_TABLE_MIN_ELEMS", 0)
+    c1, m1, _ = cover.merge_and_new_host(a1, sigs, update=True)
+    monkeypatch.setattr(cover, "CLAIM_TABLE_MIN_ELEMS", 1 << 60)
+    c2, m2, _ = cover.merge_and_new_host(a2, sigs, update=True)
+    np.testing.assert_array_equal(c1, c2)
+    np.testing.assert_array_equal(a1, a2)

@@ -46,39 +46,14 @@ def test_minimize_drops_duplicates():
     assert keep.sum() == 1
 
 
-def test_signal_stats_matches_jnp():
-    rng = np.random.default_rng(3)
-    acc = rand_bits(rng, 1, 384)[0] & rand_bits(rng, 1, 384)[0]
-    progs = rand_bits(rng, 7, 384) & rand_bits(rng, 7, 384)
-    counts, merged = pallas_cover.signal_stats(acc, progs)
-    counts, merged = np.asarray(counts), np.asarray(merged)
-    exp_fresh = progs & ~acc[None, :]
-    exp_counts = np.array(
-        [bin(int.from_bytes(r.tobytes(), "little")).count("1")
-         for r in exp_fresh])
-    np.testing.assert_array_equal(counts, exp_counts)
-    np.testing.assert_array_equal(
-        merged, acc | np.bitwise_or.reduce(progs, axis=0))
-
-
-def test_signal_stats_nonaligned_length():
-    """L not a multiple of 1024 exercises the tile padding path."""
-    rng = np.random.default_rng(4)
-    acc = rand_bits(rng, 1, 100)[0]
-    progs = rand_bits(rng, 3, 100)
-    counts, merged = pallas_cover.signal_stats(acc, progs)
-    assert merged.shape == (100,)
-    exp_fresh = progs & ~acc[None, :]
-    exp_counts = np.array(
-        [bin(int.from_bytes(r.tobytes(), "little")).count("1")
-         for r in exp_fresh])
-    np.testing.assert_array_equal(np.asarray(counts), exp_counts)
-
-
 def test_large_fallback_matches():
-    """Above MAX_VMEM_WORDS the wrapper must fall back, same semantics."""
+    """Above MAX_VMEM_WORDS the dispatcher must fall back, same
+    semantics, and count the fallback."""
     rng = np.random.default_rng(5)
     bits = rand_bits(rng, 3, 64)
+    from syzkaller_tpu.telemetry import get_registry
+
+    before = get_registry().snapshot()
     old = pallas_cover.MAX_VMEM_WORDS
     try:
         pallas_cover.MAX_VMEM_WORDS = 16  # force fallback
@@ -87,3 +62,155 @@ def test_large_fallback_matches():
         pallas_cover.MAX_VMEM_WORDS = old
     keep_jnp = np.asarray(cover.minimize_corpus(jnp.asarray(bits)))
     np.testing.assert_array_equal(keep_fb, keep_jnp)
+    delta = get_registry().delta(before)
+    assert delta.get("pallas_cover_fallback_total", 0) >= 1
+
+
+# ---- fused merge + new-signal kernel (ISSUE 8) ----
+
+
+SENT = 0xFFFFFFFF
+
+
+def _host(acc, sigs, update=False):
+    return cover.merge_and_new_host(acc, sigs, update=update)
+
+
+def _assert_all_parity(acc, sigs):
+    """The pallas kernel, the XLA core, and the numpy host mirror must
+    be bit-identical on (counts, mask, merged)."""
+    hc, hm, hmerged = _host(acc.copy(), sigs, update=True)
+    pc, pm, pmerged = pallas_cover.merge_and_new_pallas(acc, sigs)
+    xc, xm, xmerged = cover._merge_and_new_xla(acc, sigs)
+    for c, m, mg in ((pc, pm, pmerged), (xc, xm, xmerged)):
+        np.testing.assert_array_equal(np.asarray(c), hc)
+        np.testing.assert_array_equal(np.asarray(m), hm)
+        np.testing.assert_array_equal(np.asarray(mg), hmerged)
+    return hc
+
+
+def test_fused_merge_parity_random():
+    rng = np.random.default_rng(10)
+    acc = rand_bits(rng, 1, 256)[0] & rand_bits(rng, 1, 256)[0]
+    sigs = rand_bits(rng, 9, 13)
+    sigs[rng.random(sigs.shape) < 0.25] = SENT
+    _assert_all_parity(acc, sigs)
+
+
+def test_fused_merge_empty_batch():
+    acc = np.zeros(64, np.uint32)
+    counts, mask, merged = cover.merge_and_new(
+        acc, np.zeros((0, 8), np.uint32))
+    assert np.asarray(counts).shape == (0,)
+    assert np.asarray(mask).shape == (0,)
+    np.testing.assert_array_equal(np.asarray(merged), acc)
+    hc, hm, hacc = _host(acc.copy(), np.zeros((0, 8), np.uint32))
+    assert hc.shape == (0,) and hm.shape == (0,)
+
+
+def test_fused_merge_duplicate_rows():
+    """A bit claimed by an earlier row never counts again — duplicate
+    rows after the first report zero new bits (sequential-prefix
+    semantics), and in-row duplicate values count once."""
+    rng = np.random.default_rng(11)
+    acc = np.zeros(128, np.uint32)
+    row = rand_bits(rng, 1, 6)
+    sigs = np.repeat(row, 4, axis=0)
+    sigs = np.concatenate([sigs, np.full((1, 6), row[0, 0], np.uint32)])
+    counts = _assert_all_parity(acc, sigs)
+    assert counts[0] > 0
+    assert not counts[1:].any()
+
+
+def test_fused_merge_all_novel_and_all_known():
+    rng = np.random.default_rng(12)
+    sigs = rand_bits(rng, 6, 8)
+    empty = np.zeros(1 << 12, np.uint32)
+    counts = _assert_all_parity(empty, sigs)
+    assert (counts > 0).all()  # all-novel vs an empty accumulator
+    # fold them in, then the same batch is all-known
+    _, _, full = _host(empty.copy(), sigs, update=True)
+    counts2 = _assert_all_parity(full, sigs)
+    assert not counts2.any()
+
+
+def test_fused_merge_nontile_aligned_nwords():
+    """L neither a multiple of 128 lanes nor a power of two exercises
+    the tile padding AND the non-pow2 (nbits-1) index mask — all three
+    implementations must agree bit-for-bit anyway."""
+    rng = np.random.default_rng(13)
+    acc = rand_bits(rng, 1, 100)[0] & rand_bits(rng, 1, 100)[0]
+    sigs = rand_bits(rng, 5, 7)
+    sigs[0, 3:] = SENT
+    merged = _host(acc.copy(), sigs, update=True)[2]
+    assert merged.shape == (100,)
+    _assert_all_parity(acc, sigs)
+
+
+def test_fused_merge_counts_match_sequential_scan():
+    """The fused popcount-delta verdicts equal folding the rows one at
+    a time with signal_new/signal_add — the exactness claim that lets
+    the engine replace its sequential scan."""
+    rng = np.random.default_rng(14)
+    acc = np.zeros(256, np.uint32)
+    sigs = rand_bits(rng, 10, 5)
+    counts, mask, merged = _host(acc.copy(), sigs, update=True)
+    bits = jnp.asarray(np.zeros(256, np.uint32))
+    seq_mask = []
+    for row in sigs:
+        seq_mask.append(bool(cover.signal_new(bits, jnp.asarray(row))))
+        bits = cover.signal_add(bits, jnp.asarray(row))
+    assert list(mask) == seq_mask
+    np.testing.assert_array_equal(np.asarray(bits), merged)
+
+
+def test_signal_stats_retired():
+    """The dead dense-input kernel is GONE (ISSUE 8 satellite): the
+    fused entry is its wired replacement."""
+    assert not hasattr(pallas_cover, "signal_stats")
+
+
+# ---- measured-crossover dispatch ----
+
+
+def test_dispatch_probe_measures_once_and_caches(monkeypatch):
+    """Off the interpreter, the first dispatch per (op, size-bucket)
+    times BOTH paths (after a warm-up each) and caches the winner; a
+    losing pallas path counts the fallback metric on every dispatch."""
+    from syzkaller_tpu.telemetry import get_registry
+
+    monkeypatch.setattr(pallas_cover, "_INTERPRET", False)
+    monkeypatch.setattr(pallas_cover, "_platform", lambda: "tpu")
+    pallas_cover.crossover_reset()
+    calls = {"pallas": 0, "xla": 0}
+
+    def slow_pallas():
+        calls["pallas"] += 1
+        import time as _t
+
+        _t.sleep(0.01)
+        return "pallas"
+
+    def fast_xla():
+        calls["xla"] += 1
+        return "xla"
+
+    before = get_registry().snapshot()
+    out = pallas_cover.dispatch("t", 64, 4, slow_pallas, fast_xla)
+    assert out == "xla"  # probe measured pallas slower
+    assert calls == {"pallas": 2, "xla": 2}  # warm-up + timed, each
+    out = pallas_cover.dispatch("t", 64, 4, slow_pallas, fast_xla)
+    assert out == "xla" and calls["pallas"] == 2  # cached: no re-probe
+    delta = get_registry().delta(before)
+    assert delta.get("pallas_cover_fallback_total", 0) == 2
+    pallas_cover.crossover_reset()
+
+
+def test_dispatch_interpret_always_pallas(monkeypatch):
+    """Under the test interpreter the kernel path always runs — the
+    interpreter exists to exercise kernel logic, not to win races."""
+    assert pallas_cover._INTERPRET
+    pallas_cover.crossover_reset()
+    out = pallas_cover.dispatch("t", 64, 4, lambda: "pallas",
+                                lambda: "xla")
+    assert out == "pallas"

@@ -437,7 +437,27 @@ def test_bench_json_line_schema(monkeypatch, capsys):
     monkeypatch.setattr(bench, "bench_device_mutate",
                         lambda dt, C=16: 1000.0)
     monkeypatch.setattr(bench, "bench_host_mutate", lambda target: 10.0)
-    monkeypatch.setattr(bench, "bench_cover_merge", lambda: (20.0, 2.0))
+    monkeypatch.setattr(
+        bench, "bench_cover_merge_sweep",
+        lambda: {f"nbits{b.bit_length() - 1}_t{t // 1000}k": {
+            "device": 20.0, "device_measured_traces": min(t, 10_000),
+            "host": 2.0, "fused": 200.0}
+            for b in bench.COVER_SWEEP_NBITS
+            for t in bench.COVER_SWEEP_TRACES})
+    monkeypatch.setattr(
+        bench, "bench_minimize_bisect",
+        lambda target: {
+            "sequential": {"items": 4, "execs": 100,
+                           "execs_per_item": 25.0, "wall_s": 1.0,
+                           "wall_per_item_s": 0.25, "rounds": None,
+                           "serial_roundtrips_per_item": 25.0,
+                           "new_inputs": 4},
+            "batched": {"items": 4, "execs": 100,
+                        "execs_per_item": 25.0, "wall_s": 0.5,
+                        "wall_per_item_s": 0.125, "rounds": 30,
+                        "serial_roundtrips_per_item": 7.5,
+                        "new_inputs": 4},
+            "minimized_equal": True})
     monkeypatch.setattr(bench, "bench_hints", lambda: (30.0, 3.0))
     # e2e-style configs return (rate, execs, new_inputs, efficiency)
     # per side so the JSON line can report execs-per-new-input (yield
@@ -490,8 +510,20 @@ def test_bench_json_line_schema(monkeypatch, capsys):
     psweep = doc["configs"]["prefix_depth_sweep"]
     for n in bench.PREFIX_SWEEP_LENGTHS:
         assert "calls_reduction" in psweep[f"len{n}"]
-    for name in ("mutate", "cover_merge_10k", "hints_100k",
-                 "e2e_triage", "arena_sweep", "hub_sync",
+    # cover_merge_sweep: every (nbits, traces) cell carries all three
+    # paths (fused may be None on a pre-ISSUE 8 engine — not here)
+    csweep = doc["configs"]["cover_merge_sweep"]
+    for b in bench.COVER_SWEEP_NBITS:
+        for t in bench.COVER_SWEEP_TRACES:
+            cell = csweep[f"nbits{b.bit_length() - 1}_t{t // 1000}k"]
+            assert {"device", "host", "fused",
+                    "device_measured_traces"} <= set(cell)
+    mb = doc["configs"]["minimize_bisect"]
+    assert mb["minimized_equal"] is True
+    assert mb["batched"]["serial_roundtrips_per_item"] < \
+        mb["sequential"]["serial_roundtrips_per_item"]
+    for name in ("mutate", "cover_merge_sweep", "minimize_bisect",
+                 "hints_100k", "e2e_triage", "arena_sweep", "hub_sync",
                  "prefix_depth_sweep"):
         cfg = doc["configs"][name]
         assert "error" not in cfg
