@@ -639,6 +639,57 @@ def bench_hub(n_progs=4000):
     return _median_rate(run, reps=3, min_seconds=0)
 
 
+# ------------------------------------------------------------------ #
+# config: hlo compiler-frontend e2e (ISSUE 16)
+
+
+def bench_hlo_e2e(seconds=10.0):
+    """The hlo frontend's end-to-end loop: generate/mutate op programs,
+    compile+run them under pass settings, differentially check against
+    the numpy reference, and chase the seeded differential bugs.
+    Reports execs/sec, the structural compile-cache hit rate, and
+    miscompares found vs seeded.  Import-guarded so the SAME harness
+    runs on engines predating the frontends package (pre rounds report
+    nulls)."""
+    try:
+        from syzkaller_tpu import frontends
+        from syzkaller_tpu.frontends.hlo import bugs as hbugs
+    except ImportError:
+        return {"execs_per_sec": None, "compile_cache_hit_rate": None,
+                "miscompares_found": None, "seeded": None}
+    from syzkaller_tpu.engine.fuzzer import Fuzzer, FuzzerConfig
+    from syzkaller_tpu.telemetry import get_registry
+
+    reg = get_registry()
+    plan = hbugs.default_plan()
+    hbugs.install(plan)
+    try:
+        t = frontends.get("hlo").make_target()
+        cfg = FuzzerConfig(frontend="hlo", use_device=False, procs=1,
+                           program_length=8, smash_mutations=4)
+        with Fuzzer(t, cfg) as f:
+            rate, execs, ni, delta = _timed_loop(f, seconds, reg,
+                                                 warmup=5)
+        fc = delta.get("frontend_compiles_total", 0)
+        fh = delta.get("frontend_compile_cache_hits_total", 0)
+        return {
+            "execs_per_sec": round(rate, 1),
+            "execs": execs,
+            "new_inputs": ni,
+            "compile_cache_hit_rate": (round(fh / (fh + fc), 3)
+                                       if (fh or fc) else None),
+            "miscompares_found": delta.get(
+                "frontend_miscompares_total", 0),
+            "exceptions_found": delta.get("frontend_exceptions_total", 0),
+            "timeouts_found": delta.get(
+                "frontend_exec_timeouts_total", 0),
+            "bugs_fired": sorted(plan.fired_names()),
+            "seeded": len(plan.bugs),
+        }
+    finally:
+        hbugs.clear()
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(prog="bench")
     ap.add_argument("--telemetry-out", default="",
@@ -732,6 +783,13 @@ def main(argv=None):
                 "efficiency": {"device": dev_eff, "host": host_eff}}
 
     run_config("e2e_triage", _e2e)
+
+    def _hlo_e2e():
+        res = bench_hlo_e2e()
+        res["unit"] = "execs/sec (compiler-frontend differential loop)"
+        return res
+
+    run_config("hlo_e2e", _hlo_e2e)
 
     def _arena_sweep():
         res = bench_arena_sweep(target)

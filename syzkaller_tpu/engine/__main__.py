@@ -20,6 +20,9 @@ def main(argv=None) -> int:
     ap.add_argument("-procs", type=int, default=1)
     ap.add_argument("-os", default="linux")
     ap.add_argument("-arch", default="amd64")
+    ap.add_argument("-frontend", "--frontend", default="syscall",
+                    help="frontend to fuzz: syscall (kernel, default) or "
+                    "hlo (XLA compiler, in-process differential executor)")
     ap.add_argument("-mock", action="store_true",
                     help="mock executor (hermetic)")
     ap.add_argument("-no-detect", action="store_true",
@@ -48,13 +51,20 @@ def main(argv=None) -> int:
         ap.error("--resume requires -workdir (the checkpoint lives at "
                  "<workdir>/engine.ckpt)")
 
-    from ..prog import get_target
+    from .. import frontends
     from ..telemetry import set_spans_enabled, telemetry_dump_to
     from .fuzzer import Fuzzer, FuzzerConfig
 
+    # validate up front: an unknown frontend must die with the registry's
+    # name list at argument-parse time (exit 2), not as an AttributeError
+    # deep inside the first batch
+    if args.frontend not in frontends.names():
+        ap.error(f"unknown frontend {args.frontend!r} "
+                 f"(available: {', '.join(frontends.names())})")
+
     if args.no_spans:
         set_spans_enabled(False)
-    target = get_target(args.os, args.arch)
+    target = frontends.get(args.frontend).make_target(args.os, args.arch)
     manager = None
     if args.manager:
         from ..manager.rpc import RemoteManager
@@ -65,7 +75,10 @@ def main(argv=None) -> int:
         mock=args.mock,
         use_device=args.device,
         sandbox=args.sandbox,
-        detect_supported=not args.no_detect and not args.mock,
+        frontend=args.frontend,
+        # live syscall detection only makes sense against a kernel
+        detect_supported=(not args.no_detect and not args.mock
+                          and args.frontend == "syscall"),
         leak_check=args.leak_check,
         workdir=args.workdir,
         resume=args.resume,

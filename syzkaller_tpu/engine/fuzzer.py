@@ -27,7 +27,7 @@ from collections import OrderedDict, deque
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Set
 
-from ..ipc import CallInfo, Env, EnvConfig, ExecOpts, MockEnv
+from ..ipc import CallInfo, EnvConfig, ExecOpts
 from ..prog.analysis import assign_sizes_call
 from ..telemetry import (
     Provenance,
@@ -141,6 +141,12 @@ class FuzzerConfig:
     journal: bool = True
     journal_max_bytes: int = 4 << 20
     journal_segments: int = 4
+    # ---- frontend selection (frontends/__init__.py registry) ----
+    # which (target, executor) pair the campaign fuzzes: "syscall" is
+    # the kernel-fuzzing default (parity-pinned), "hlo" the in-process
+    # XLA compiler-fuzzing frontend.  Everything above the env boundary
+    # is frontend-agnostic.
+    frontend: str = "syscall"
 
 
 class ManagerConn:
@@ -363,15 +369,17 @@ class Fuzzer:
         for text in conn.get("candidates", ()):
             self._push_candidate_text(text)
 
+        # env construction goes through the frontend registry: the
+        # default "syscall" frontend reproduces the historical MockEnv /
+        # Env loop exactly (parity-pinned by tests/test_frontends.py),
+        # "hlo" swaps in the in-process differential executor — same
+        # drain/supervision/prefix machinery either way.
+        from .. import frontends as _frontends
+
+        self.frontend = _frontends.get(self.cfg.frontend)
         self.envs: List = []
         for pid in range(self.cfg.procs):
-            if self.cfg.mock:
-                self.envs.append(MockEnv(
-                    target, pid=pid,
-                    prefix_cache_entries=self.cfg.prefix_cache_entries))
-            else:
-                ec = self.cfg.env_config or EnvConfig(sandbox=self.cfg.sandbox)
-                self.envs.append(Env(target, pid=pid, config=ec))
+            self.envs.append(self.frontend.make_env(target, pid, self.cfg))
         # drain-path supervision: backoff/quarantine/watchdog over the fleet
         self.supervisor = EnvSupervisor(
             len(self.envs),

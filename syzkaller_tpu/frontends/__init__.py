@@ -1,0 +1,67 @@
+"""Frontend registry: pluggable (target, executor) pairs for the engine.
+
+The engine is a generic coverage-guided tensor-program fuzzer: fixed-width
+integer program rows (prog/tensor.py), vmapped mutation (ops/mutation.py),
+packed-bitset signal (ops/cover.py), device admission (ops/admission.py).
+Nothing in that loop knows what a "syscall" is — a *frontend* supplies the
+two domain-specific pieces:
+
+    make_target(os, arch) -> prog.target.Target
+        the op table ("syscalls"), resources, and arch hooks the codec,
+        generator, and mutator compile into flat tables;
+    make_env(target, pid, cfg) -> ipc.Env-compatible executor
+        exec/exec_raw/exec_prefix/exec_suffix/close/restarts — the thing
+        that turns an exec byte stream into per-call signal.
+
+Built-in frontends:
+
+    ``syscall`` — the original kernel-fuzzing frontend: bundled OS
+        descriptions + the C++ in-VM executor (or MockEnv when
+        ``cfg.mock``).  The default; the registry path is parity-pinned
+        against the pre-registry construction by tests/test_frontends.py.
+    ``hlo``     — StableHLO/XLA-style compiler fuzzing: ops are tensor
+        operations, the executor is an in-process JAX compile+run harness
+        with differential checking (frontends/hlo/).
+
+Everything above the env boundary — arena, admission, prefix memoization,
+supervision, checkpoint/resume, journal, fleet dashboard — is reused
+unchanged across frontends; that reuse is pinned by tests.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+_registry: Dict[str, object] = {}
+
+
+def register(frontend) -> None:
+    """Register a frontend under ``frontend.name`` (last wins, so tests
+    can shadow a built-in with an instrumented double)."""
+    _registry[frontend.name] = frontend
+
+
+def names() -> List[str]:
+    """Registered frontend names, sorted — the CLI's rejection message
+    and ``--frontend`` validation both quote this list."""
+    return sorted(_registry)
+
+
+def get(name: str):
+    """Look up a frontend by name; unknown names raise KeyError carrying
+    the full name list so callers can surface actionable errors."""
+    if name not in _registry:
+        raise KeyError(
+            f"unknown frontend {name!r} (available: {', '.join(names())})")
+    return _registry[name]
+
+
+# Built-ins register at import time: the registry must be complete before
+# any FuzzerConfig.frontend lookup or CLI validation runs.  The hlo
+# frontend's executor imports jax lazily, so registering it here costs
+# nothing on engines that never select it.
+from . import syscall as _syscall  # noqa: E402
+from . import hlo as _hlo  # noqa: E402
+
+register(_syscall.SyscallFrontend())
+register(_hlo.HloFrontend())

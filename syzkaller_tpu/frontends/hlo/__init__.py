@@ -1,0 +1,28 @@
+"""The ``hlo`` frontend: fuzz the tensor compiler itself.
+
+"Syscalls" are StableHLO/XLA-style ops (frontends/hlo/target.py), the
+executor is an in-process JAX compile+run differential harness
+(frontends/hlo/executor.py), and the pass pipeline rides in the same
+fixed-width program row as the IR so mutation and minimization treat
+both jointly.  Everything above the env boundary is the stock engine.
+"""
+
+from __future__ import annotations
+
+from . import target as _target
+
+
+class HloFrontend:
+    name = "hlo"
+    description = ("XLA/StableHLO compiler fuzzing: in-process JAX "
+                   "differential executor")
+
+    def make_target(self, os: str = "hlo", arch: str = "xla"):
+        # os/arch args are accepted for factory-signature parity with the
+        # syscall frontend; there is exactly one hlo target.
+        return _target.ensure_registered()
+
+    def make_env(self, target, pid: int, cfg):
+        from .executor import HloEnv
+
+        return HloEnv(target, pid=pid)

@@ -1,0 +1,99 @@
+"""Seeded compiler-bug harness for the hlo frontend.
+
+The differential executor's correctness claim — miscompares / crashes /
+hangs found, triaged, minimized, journaled — needs ground truth to test
+against, the way ``testing/faults.py`` gives the supervision paths
+deterministic chaos.  A ``BugPlan`` declares known-bad (op, pass)
+combinations; the executor consults the installed plan per program and,
+when a bug's trigger matches, manufactures the corresponding failure in
+the OPTIMIZED run only:
+
+    ``miscompare`` — the optimized output of the trigger op's node is
+        perturbed, so the differential comparator reports it;
+    ``exception``  — the "compiler" raises at the trigger node;
+    ``timeout``    — the optimized run reports a deadline overrun.
+
+Triggers are pure functions of program CONTENT (op present AND, when
+``pass_name`` is set, that pass marker present) — never of occurrence
+counts — so a seeded bug reproduces under triage's reruns and survives
+exactly those minimization steps that keep both the trigger op and the
+required pass marker.  That is what makes "minimize shrinks both the op
+program and the pass list" a testable property: dropping either side of
+the trigger makes the bug vanish, so the minimizer must keep both.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class SeededBug:
+    """One known-bad (op, pass) combination.  ``kind`` selects the
+    failure mode; ``pass_name`` == "" triggers on the op alone."""
+    name: str
+    op: str
+    pass_name: str = ""
+    kind: str = "miscompare"  # miscompare | exception | timeout
+
+
+@dataclass
+class BugPlan:
+    """A set of seeded bugs plus a fired log (bug name, trigger op node
+    index) so tests can assert exactly which bugs a campaign tickled."""
+    bugs: Tuple[SeededBug, ...] = ()
+    _fired: List[Tuple[str, int]] = field(default_factory=list)
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+
+    def match(self, op_names, pass_names) -> List[SeededBug]:
+        """Bugs whose trigger is satisfied by this program's op multiset
+        and pass marker set (content-only: deterministic under rerun)."""
+        ops = set(op_names)
+        passes = set(pass_names)
+        return [b for b in self.bugs
+                if b.op in ops and (not b.pass_name or b.pass_name in passes)]
+
+    def record(self, bug: SeededBug, node_idx: int) -> None:
+        with self._lock:
+            self._fired.append((bug.name, node_idx))
+
+    def fired(self) -> List[Tuple[str, int]]:
+        with self._lock:
+            return list(self._fired)
+
+    def fired_names(self):
+        return {name for name, _ in self.fired()}
+
+
+_active: Optional[BugPlan] = None
+
+
+def install(plan: Optional[BugPlan]) -> None:
+    """Make ``plan`` the process-wide seeded-bug plan (None to disarm).
+    No plan installed -> the executor's consult hook is one global read."""
+    global _active
+    _active = plan
+
+
+def clear() -> None:
+    install(None)
+
+
+def active() -> Optional[BugPlan]:
+    return _active
+
+
+def default_plan() -> BugPlan:
+    """The stock seeded-bug set used by the e2e test and bench harness:
+    one bug per failure mode, each requiring an op AND a pass marker so
+    minimization provably has to keep both."""
+    return BugPlan(bugs=(
+        SeededBug(name="fold-dot-miscompare", op="hlo_dot",
+                  pass_name="hlo_pass_fold", kind="miscompare"),
+        SeededBug(name="cse-tanh-miscompare", op="hlo_tanh",
+                  pass_name="hlo_pass_cse", kind="miscompare"),
+        SeededBug(name="fuse-convert-crash", op="hlo_convert",
+                  pass_name="hlo_pass_fuse", kind="exception"),
+    ))

@@ -1,0 +1,658 @@
+"""In-process differential executor for the hlo frontend.
+
+``HloEnv`` is ``ipc.Env``-compatible (exec / exec_raw / exec_prefix /
+exec_suffix / close / restarts), but instead of shipping a byte stream to
+a forked C++ executor it:
+
+  1. decodes the exec wire format to a tensor-op node graph (the exec
+     stream's result-arg indices ARE the def-use edges — hlo programs
+     are pointer-free, so instruction index == call index);
+  2. statically infers every node's shape/dtype and the operand-coercion
+     recipe (resize / cast / axis-mod), so the un-optimized reference and
+     the optimized run execute THE SAME defined semantics — any
+     divergence is the compiler's, not the harness's;
+  3. applies the program's pass pipeline (frontends/hlo/passes.py, mask
+     taken from the ``hlo_pass_*`` markers in the row), compiles the
+     transformed graph with ``jax.jit`` under a structural-hash LRU
+     compile cache, and runs it;
+  4. interprets the ORIGINAL graph eagerly with numpy as the reference,
+     and differentially compares outputs — miscompare / exception /
+     timeout becomes a crash report through the existing manager crash
+     path (``telemetry.journal_emit("crash", ...)`` — the exact call
+     ``Manager.save_crash`` makes), attached as a distinctive crash PC
+     on the trigger call so triage/minimize work unchanged;
+  5. emits per-call coverage as hashed (op-kind, dtype, rank,
+     pass-decision) n-gram PCs — plain ints the engine folds into the
+     packed bitset via ``ops/cover.merge_and_new`` like any other signal.
+
+Crashes are reported with ``failed=False``: the engine's execute() path
+skips signal scanning for failed programs, and a miscompare is exactly
+the signal we want triaged.  Env *death* (supervision testing) keeps the
+``testing/faults.py`` ``env.exec:<pid>`` site contract from MockEnv.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...ipc import CallInfo, ExecOpts
+from ...prog.encodingexec import decode_exec, serialize_for_exec
+from ...prog.prog import Prog
+from ...telemetry import get_registry, journal_emit, span
+from ...testing import faults as _faults
+from . import bugs as _bugs
+from .passes import apply_passes, pass_mask
+from .target import DTYPES, NP_DTYPES, SHAPES
+
+COMPILE_CACHE_ENTRIES = 512
+DEFAULT_TIMEOUT_S = 5.0
+
+_I32_MIN, _I32_MAX = -(1 << 31), (1 << 31) - 1
+_U32_MAX = (1 << 32) - 1
+
+_UNARY = {"hlo_neg", "hlo_abs", "hlo_tanh", "hlo_exp"}
+_BINARY = {"hlo_add", "hlo_sub", "hlo_mul", "hlo_max", "hlo_min", "hlo_div"}
+_REDUCE = {"hlo_reduce_sum", "hlo_reduce_max"}
+_FLOAT_FORCED = {"hlo_tanh", "hlo_exp"}
+
+
+def _pc(*parts) -> int:
+    """Stable coverage PC: a 32-bit hash of the part tuple (hashlib, not
+    hash() — PCs must agree across processes and PYTHONHASHSEED)."""
+    h = hashlib.blake2b(repr(parts).encode(), digest_size=4)
+    return int.from_bytes(h.digest(), "little")
+
+
+class Node:
+    """One decoded op instruction: the graph the passes transform and
+    both interpreters evaluate.  ``lit`` non-None marks a literal leaf
+    (const/iota values, plus fold results) — literal ARRAYS are runtime
+    inputs to the jitted function, so the compile cache keys on graph
+    STRUCTURE, never on constant values."""
+
+    __slots__ = ("idx", "op", "call_id", "dtype", "shape", "srcs", "axis",
+                 "lit", "is_output", "dead", "folded", "reassoc_extra")
+
+    def __init__(self, idx: int, op: str, dtype: int = 0,
+                 shape: Tuple[int, ...] = (), srcs=None, axis: int = 0):
+        self.idx = idx
+        self.op = op
+        self.call_id = 0            # wire syscall id (for CallInfo.num)
+        self.dtype = dtype          # index into DTYPES
+        self.shape = shape          # inferred static shape
+        self.srcs = list(srcs or [])
+        self.axis = axis
+        self.lit: Optional[np.ndarray] = None
+        self.is_output = False
+        self.dead = False
+        self.folded = False
+        self.reassoc_extra: Optional[int] = None
+
+    def clone(self) -> "Node":
+        n = Node(self.idx, self.op, self.dtype, self.shape,
+                 list(self.srcs), self.axis)
+        n.call_id = self.call_id
+        n.lit = self.lit
+        n.is_output = self.is_output
+        n.dead = self.dead
+        n.folded = self.folded
+        n.reassoc_extra = self.reassoc_extra
+        return n
+
+    def structural_key(self) -> tuple:
+        lit_sig = (None if self.lit is None
+                   else (self.lit.shape, str(self.lit.dtype)))
+        return (self.op, self.dtype, self.shape, tuple(self.srcs),
+                self.axis, lit_sig, self.reassoc_extra)
+
+    @property
+    def produces(self) -> bool:
+        return self.op not in ("hlo_setup",) \
+            and not self.op.startswith("hlo_pass_")
+
+
+def _np_dtype(di: int):
+    return NP_DTYPES[di % len(NP_DTYPES)]
+
+
+def _is_float(di: int) -> bool:
+    return DTYPES[di % len(DTYPES)] == "f32"
+
+
+def _cast(x, di: int, xp):
+    """Defined-semantics convert: NaN/Inf scrubbed and range clamped
+    before float->int casts, so numpy and XLA agree where raw casts are
+    implementation-defined."""
+    dt = _np_dtype(di)
+    if x.dtype == dt:
+        return x
+    if not _is_float(di) and np.issubdtype(x.dtype, np.floating):
+        x = xp.nan_to_num(x, nan=0.0, posinf=0.0, neginf=0.0)
+        lo, hi = (0, _U32_MAX) if dt is np.uint32 else (_I32_MIN, _I32_MAX)
+        # float bounds: a Python int >= 2**31 would overflow jax's x32
+        # weak-typing before the clip even runs
+        x = xp.clip(x, float(lo), float(hi))
+    return x.astype(dt)
+
+
+def _coerce(x, shape: Tuple[int, ...], di: int, xp):
+    """Coerce an operand to the consumer's static (shape, dtype): scalars
+    broadcast, anything else is cycled through ``resize`` — one rule,
+    applied identically by the reference and the optimized run."""
+    x = _cast(x, di, xp)
+    if tuple(x.shape) == tuple(shape):
+        return x
+    if x.ndim == 0:
+        return xp.broadcast_to(x, shape)
+    return xp.resize(x, shape)
+
+
+class _Graph:
+    """A decoded program: node list + the pass mask its markers enable."""
+
+    def __init__(self, nodes: List[Node], mask: int, op_names: List[str],
+                 pass_names: List[str]):
+        self.nodes = nodes
+        self.mask = mask
+        self.op_names = op_names
+        self.pass_names = pass_names
+
+    def outputs(self) -> List[Node]:
+        return [n for n in self.nodes if n.is_output]
+
+
+def _iota_lit(di: int, shape: Tuple[int, ...]) -> np.ndarray:
+    n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    return np.arange(n, dtype=_np_dtype(di)).reshape(shape)
+
+
+def _const_lit(di: int, shape: Tuple[int, ...], val: int) -> np.ndarray:
+    # canonicalize the raw 64-bit row value into something every dtype
+    # represents exactly (so encode/decode round trips can't drift)
+    v = int(val) % 256
+    return np.full(shape, v, dtype=_np_dtype(di))
+
+
+def build_graph(instrs, id_to_name: Dict[int, str]) -> _Graph:
+    """Decoded exec stream -> node graph with static shape/dtype
+    inference.  Result-arg indices point at instruction positions; a
+    reference to a non-producing instruction (setup, pass marker,
+    out-of-range after mutation) falls back to a literal zero scalar —
+    every syntactically valid row is executable."""
+    nodes: List[Node] = []
+    op_names: List[str] = []
+    pass_names: List[str] = []
+
+    def src_of(arg) -> int:
+        if arg["kind"] == "result":
+            i = int(arg["index"])
+            if 0 <= i < len(nodes) and nodes[i].produces:
+                return i
+        return -1
+
+    for ins in instrs:
+        if ins["op"] != "call":
+            continue
+        i = len(nodes)
+        name = id_to_name.get(ins["id"], f"hlo_unknown_{ins['id']}")
+        op_names.append(name)
+        args = ins["args"]
+
+        def cval(k: int, default: int = 0) -> int:
+            if k < len(args) and args[k]["kind"] == "const":
+                return int(args[k]["value"])
+            return default
+
+        n = Node(i, name)
+        n.call_id = int(ins["id"])
+        if name.startswith("hlo_pass_"):
+            pass_names.append(name)
+        elif name == "hlo_const":
+            n.dtype = cval(0) % len(DTYPES)
+            n.shape = SHAPES[cval(1) % len(SHAPES)]
+            n.lit = _const_lit(n.dtype, n.shape, cval(2))
+        elif name == "hlo_iota":
+            n.dtype = cval(0) % len(DTYPES)
+            n.shape = SHAPES[cval(1) % len(SHAPES)]
+            n.lit = _iota_lit(n.dtype, n.shape)
+        elif name in _UNARY:
+            s = src_of(args[0]) if args else -1
+            n.srcs = [s]
+            base = nodes[s] if s >= 0 else None
+            n.shape = base.shape if base else ()
+            n.dtype = 0 if name in _FLOAT_FORCED else (
+                base.dtype if base else 0)
+        elif name in _BINARY:
+            a = src_of(args[0]) if args else -1
+            b = src_of(args[1]) if len(args) > 1 else -1
+            n.srcs = [a, b]
+            base = nodes[a] if a >= 0 else None
+            n.shape = base.shape if base else ()
+            n.dtype = base.dtype if base else 0
+        elif name in _REDUCE:
+            s = src_of(args[0]) if args else -1
+            n.srcs = [s]
+            base = nodes[s] if s >= 0 else None
+            rank = len(base.shape) if base else 0
+            n.axis = cval(1) % rank if rank else 0
+            n.dtype = base.dtype if base else 0
+            n.shape = (tuple(d for k, d in enumerate(base.shape)
+                             if k != n.axis) if base else ())
+        elif name == "hlo_dot":
+            a = src_of(args[0]) if args else -1
+            b = src_of(args[1]) if len(args) > 1 else -1
+            n.srcs = [a, b]
+            n.dtype = nodes[a].dtype if a >= 0 else 0
+            n.shape = ()
+        elif name in ("hlo_reshape", "hlo_broadcast"):
+            s = src_of(args[0]) if args else -1
+            n.srcs = [s]
+            n.dtype = nodes[s].dtype if s >= 0 else 0
+            n.shape = SHAPES[cval(1) % len(SHAPES)]
+        elif name == "hlo_convert":
+            s = src_of(args[0]) if args else -1
+            n.srcs = [s]
+            n.dtype = cval(1) % len(DTYPES)
+            n.shape = nodes[s].shape if s >= 0 else ()
+        elif name == "hlo_select":
+            srcs = [src_of(a) for a in args[:3]]
+            srcs += [-1] * (3 - len(srcs))
+            n.srcs = srcs
+            base = nodes[srcs[1]] if srcs[1] >= 0 else None
+            n.shape = base.shape if base else ()
+            n.dtype = base.dtype if base else 0
+        elif name == "hlo_clamp":
+            srcs = [src_of(a) for a in args[:3]]
+            srcs += [-1] * (3 - len(srcs))
+            n.srcs = srcs
+            base = nodes[srcs[1]] if srcs[1] >= 0 else None
+            n.shape = base.shape if base else ()
+            n.dtype = base.dtype if base else 0
+        # hlo_setup / unknown ids: non-producing marker node
+        nodes.append(n)
+
+    consumed = set()
+    for n in nodes:
+        for s in n.srcs:
+            if s >= 0:
+                consumed.add(s)
+    for n in nodes:
+        n.is_output = n.produces and n.idx not in consumed
+    return _Graph(nodes, pass_mask(pass_names), op_names, pass_names)
+
+
+def _eval(node: Node, nodes: List[Node], memo: Dict[int, object], xp,
+          lits: Optional[Dict[int, object]] = None):
+    """The one evaluator: interprets a node against ``xp`` (numpy for the
+    eager reference, jax.numpy inside the jitted optimized function).
+    ``lits`` overrides literal leaves with runtime-supplied arrays (the
+    jit path), keeping constants out of the compiled artifact."""
+    if node.idx in memo:
+        return memo[node.idx]
+
+    def val(i: int):
+        if i < 0:
+            return xp.zeros((), dtype=np.float32)
+        return _eval(nodes[i], nodes, memo, xp, lits)
+
+    if lits is not None and node.idx in lits:
+        r = lits[node.idx]
+    elif node.lit is not None:
+        r = xp.asarray(node.lit)
+    else:
+        op, sh, dt = node.op, node.shape, node.dtype
+        if op in _UNARY:
+            x = _coerce(val(node.srcs[0]), sh, dt, xp)
+            if op == "hlo_neg":
+                r = -x
+            elif op == "hlo_abs":
+                r = xp.abs(x)
+            elif op == "hlo_tanh":
+                r = xp.tanh(x)
+            else:
+                r = xp.exp(x)
+        elif op in _BINARY:
+            a = _coerce(val(node.srcs[0]), sh, dt, xp)
+            b = _coerce(val(node.srcs[1]), sh, dt, xp)
+            r = _binop(op, a, b, dt, xp)
+            if node.reassoc_extra is not None:
+                c = _coerce(val(node.reassoc_extra), sh, dt, xp)
+                r = _binop(op, r, c, dt, xp)
+        elif op in _REDUCE:
+            x = val(node.srcs[0])
+            x = _cast(x, dt, xp)
+            if x.ndim == 0:
+                r = x
+            else:
+                ax = node.axis % x.ndim
+                if op == "hlo_reduce_sum":
+                    r = xp.sum(x, axis=ax, dtype=x.dtype)
+                else:
+                    r = xp.max(x, axis=ax)
+        elif op == "hlo_dot":
+            a = _cast(val(node.srcs[0]), dt, xp).reshape(-1)
+            b = _cast(val(node.srcs[1]), dt, xp).reshape(-1)
+            m = max(int(a.shape[0]), int(b.shape[0]), 1)
+            a = xp.resize(a, (m,))
+            b = xp.resize(b, (m,))
+            r = xp.sum(a * b, dtype=a.dtype)
+        elif op in ("hlo_reshape", "hlo_broadcast"):
+            r = _coerce(val(node.srcs[0]), sh, dt, xp)
+        elif op == "hlo_convert":
+            r = _coerce(val(node.srcs[0]), sh, dt, xp)
+        elif op == "hlo_select":
+            p = _coerce(val(node.srcs[0]), sh, dt, xp)
+            a = _coerce(val(node.srcs[1]), sh, dt, xp)
+            b = _coerce(val(node.srcs[2]), sh, dt, xp)
+            r = xp.where(p != 0, a, b)
+        elif op == "hlo_clamp":
+            lo = _coerce(val(node.srcs[0]), sh, dt, xp)
+            x = _coerce(val(node.srcs[1]), sh, dt, xp)
+            hi = _coerce(val(node.srcs[2]), sh, dt, xp)
+            r = xp.minimum(xp.maximum(x, lo), hi)
+        else:
+            # setup / pass markers / unknown: inert zero scalar
+            r = xp.zeros((), dtype=np.float32)
+    memo[node.idx] = r
+    return r
+
+
+def _binop(op: str, a, b, dt: int, xp):
+    if op == "hlo_add":
+        return a + b
+    if op == "hlo_sub":
+        return a - b
+    if op == "hlo_mul":
+        return a * b
+    if op == "hlo_max":
+        return xp.maximum(a, b)
+    if op == "hlo_min":
+        return xp.minimum(a, b)
+    # safe-div: integer denominators of 0 are defined as 1 (both engines
+    # apply the same rule, so the op has ONE semantics, not UB)
+    if not _is_float(dt):
+        b = xp.where(b == 0, xp.ones_like(b), b)
+        return (a // b).astype(a.dtype)
+    return a / b
+
+
+class HloEnv:
+    """ipc.Env-compatible in-process JAX compile+run differential
+    executor.  One per engine proc, like every other env; the compile
+    cache is per-env so restarts reset it the way a real executor
+    respawn drops its JIT state."""
+
+    supports_continuation = False
+
+    def __init__(self, target, pid: int = 0,
+                 compile_cache_entries: int = COMPILE_CACHE_ENTRIES,
+                 timeout_s: float = DEFAULT_TIMEOUT_S):
+        self.target = target
+        self.pid = pid
+        self.restarts = 0
+        self.timeout_s = timeout_s
+        self.compile_cache_entries = max(int(compile_cache_entries), 1)
+        self._compile_cache: "OrderedDict[tuple, object]" = OrderedDict()
+        self._id_to_name = {c.id: c.name for c in target.syscalls}
+        self._crash_titles = set()
+
+        reg = get_registry()
+        self._c_compiles = reg.counter(
+            "frontend_compiles_total",
+            help="hlo frontend: jit compilations (compile-cache misses)")
+        self._c_cache_hits = reg.counter(
+            "frontend_compile_cache_hits_total",
+            help="hlo frontend: structural compile-cache hits")
+        self._c_miscompares = reg.counter(
+            "frontend_miscompares_total",
+            help="hlo frontend: differential miscompares reported")
+        self._c_exceptions = reg.counter(
+            "frontend_exceptions_total",
+            help="hlo frontend: compile/run exceptions reported")
+        self._c_timeouts = reg.counter(
+            "frontend_exec_timeouts_total",
+            help="hlo frontend: compile+run deadline overruns reported")
+        self._h_compile = reg.histogram(
+            "frontend_compile_seconds",
+            help="hlo frontend: jit compile latency")
+        self._h_run = reg.histogram(
+            "frontend_run_seconds",
+            help="hlo frontend: optimized-run + reference latency")
+
+    # ---- env plumbing ------------------------------------------------
+
+    def close(self) -> None:
+        self._compile_cache.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        pass
+
+    def exec(self, opts: ExecOpts, p: Prog
+             ) -> Tuple[bytes, List[CallInfo], bool, bool]:
+        data = serialize_for_exec(p, pid=self.pid)
+        return self.exec_raw(opts, data, [c.meta.id for c in p.calls])
+
+    def exec_prefix(self, opts: ExecOpts, data: bytes,
+                    call_ids: List[int]) -> None:
+        # no continuation support: returns None so the drain scheduler
+        # never pays a wasted round trip (same contract as ipc.Env)
+        return None
+
+    def exec_suffix(self, opts: ExecOpts, data: bytes, call_ids,
+                    prefix_hash: int, prefix_calls: int):
+        out, infos, failed, hanged = self.exec_raw(opts, data, call_ids)
+        return out, infos, failed, hanged, False
+
+    # ---- the differential harness ------------------------------------
+
+    def exec_raw(self, opts: ExecOpts, data: bytes, call_ids: List[int]
+                 ) -> Tuple[bytes, List[CallInfo], bool, bool]:
+        if _faults.should_fire(f"env.exec:{self.pid}"):
+            # injected env death: report failed like a crashed executor
+            # (the drain supervisor path is frontend-agnostic)
+            self.restarts += 1
+            return b"", [], True, False
+
+        budget = self.timeout_s
+        if getattr(opts, "timeout_ms", 0):
+            budget = min(budget, opts.timeout_ms / 1000.0)
+        t0 = time.perf_counter()
+
+        graph = build_graph(decode_exec(data), self._id_to_name)
+        infos = self._cover_infos(opts, graph)
+        plan = _bugs.active()
+        matches = plan.match(graph.op_names, graph.pass_names) if plan \
+            else []
+
+        try:
+            ref, opt, timed_out = self._run_differential(graph, matches,
+                                                         plan, t0, budget)
+        except Exception as e:  # compiler raised: that IS the finding
+            idx = len(graph.nodes) - 1 if graph.nodes else 0
+            title = f"hlo-exception-{type(e).__name__}"
+            for b in matches:
+                if b.kind == "exception":
+                    idx = self._trigger_idx(graph, b)
+                    title = f"hlo-seeded-{b.name}"
+                    if plan:
+                        plan.record(b, idx)
+                    break
+            self._crash(opts, infos, idx, title, self._c_exceptions)
+            return b"", infos, False, False
+
+        if timed_out is not None:
+            self._crash(opts, infos, timed_out, "hlo-timeout",
+                        self._c_timeouts)
+            return b"", infos, False, False
+
+        self._compare(opts, graph, infos, ref, opt, matches, plan)
+        return b"", infos, False, False
+
+    def _run_differential(self, graph: _Graph, matches, plan, t0: float,
+                          budget: float):
+        """Reference-interpret the original graph (numpy, eager) and
+        compile+run the pass-transformed graph (jax); returns
+        (ref_outputs, opt_outputs, timeout_trigger_idx_or_None)."""
+        outputs = graph.outputs()
+        with np.errstate(all="ignore"):
+            memo: Dict[int, object] = {}
+            ref = {n.idx: np.asarray(_eval(n, graph.nodes, memo, np))
+                   for n in outputs}
+
+        for b in matches:
+            if b.kind == "exception":
+                raise RuntimeError(f"seeded compiler crash {b.name}")
+
+        opt = self._run_optimized(graph, outputs)
+
+        elapsed = time.perf_counter() - t0
+        for b in matches:
+            if b.kind == "timeout":
+                idx = self._trigger_idx(graph, b)
+                if plan:
+                    plan.record(b, idx)
+                return ref, opt, idx
+        if elapsed > budget:
+            return ref, opt, len(graph.nodes) - 1 if graph.nodes else 0
+        return ref, opt, None
+
+    def _run_optimized(self, graph: _Graph, outputs: List[Node]):
+        """Pass-transform, jit-compile (structural cache), run."""
+        import jax
+
+        def eager(node, nodes):
+            # const-fold evaluator: the "compile-time" engine
+            with np.errstate(all="ignore"):
+                return np.asarray(_eval(node, nodes, {}, np))
+
+        tnodes = apply_passes(graph.nodes, graph.mask, eager)
+        out_idx = [n.idx for n in outputs]
+        lit_idx = [n.idx for n in tnodes if n.lit is not None]
+        key = (tuple(n.structural_key() for n in tnodes),
+               tuple(out_idx), tuple(lit_idx))
+
+        lit_vals = tuple(tnodes[i].lit for i in lit_idx)
+        fn = self._compile_cache.get(key)
+        if fn is not None:
+            self._compile_cache.move_to_end(key)
+            self._c_cache_hits.inc()
+        else:
+            import jax.numpy as jnp
+
+            def run(lvals):
+                lits = dict(zip(lit_idx, lvals))
+                memo: Dict[int, object] = {}
+                return tuple(_eval(tnodes[i], tnodes, memo, jnp, lits)
+                             for i in out_idx)
+
+            # AOT lower+compile (jax.jit alone defers compilation to the
+            # first call, which would book compile time as run time and
+            # make the cache-hit metric meaningless)
+            with span("frontend.compile"):
+                tc = time.perf_counter()
+                fn = jax.jit(run).lower(lit_vals).compile()
+                self._h_compile.observe(time.perf_counter() - tc)
+            self._c_compiles.inc()
+            self._compile_cache[key] = fn
+            while len(self._compile_cache) > self.compile_cache_entries:
+                self._compile_cache.popitem(last=False)
+        with span("frontend.run"):
+            tr = time.perf_counter()
+            res = fn(lit_vals)
+            res = tuple(np.asarray(r) for r in res)  # block + host copy
+            self._h_run.observe(time.perf_counter() - tr)
+        return dict(zip(out_idx, res))
+
+    def _compare(self, opts, graph, infos, ref, opt, matches, plan):
+        """Differential check + seeded-miscompare injection."""
+        for b in matches:
+            if b.kind == "miscompare":
+                idx = self._trigger_idx(graph, b)
+                if plan:
+                    plan.record(b, idx)
+                self._crash(opts, infos, idx, f"hlo-seeded-{b.name}",
+                            self._c_miscompares)
+                return
+        for i, r in ref.items():
+            o = opt.get(i)
+            if o is None:
+                continue
+            if not self._agree(r, o):
+                self._crash(opts, infos, i,
+                            f"hlo-miscompare-{graph.nodes[i].op}",
+                            self._c_miscompares)
+                return
+
+    @staticmethod
+    def _agree(r: np.ndarray, o: np.ndarray) -> bool:
+        if r.shape != o.shape:
+            return False
+        if np.issubdtype(r.dtype, np.floating) \
+                or np.issubdtype(o.dtype, np.floating):
+            return bool(np.allclose(
+                r.astype(np.float64), o.astype(np.float64),
+                rtol=1e-3, atol=1e-3, equal_nan=True))
+        return bool(np.array_equal(r, o))
+
+    @staticmethod
+    def _trigger_idx(graph: _Graph, bug) -> int:
+        for n in graph.nodes:
+            if n.op == bug.op:
+                return n.idx
+        return 0
+
+    def _crash(self, opts, infos: List[CallInfo], idx: int, title: str,
+               counter) -> None:
+        """Report through the existing manager crash path: the crash PC
+        lands on the TRIGGER call's signal (stable under minimize's
+        removal of unrelated calls), errno marks it, and the journal gets
+        the same ``crash`` record ``Manager.save_crash`` writes."""
+        counter.inc()
+        if 0 <= idx < len(infos):
+            infos[idx].errno = 5
+            if opts.collect_signal:
+                infos[idx].signal.append(_pc("bug", title))
+            if opts.collect_cover:
+                infos[idx].cover.append(_pc("bug", title))
+        if title not in self._crash_titles:
+            self._crash_titles.add(title)
+            journal_emit("crash", title=title, vm=self.pid,
+                         frontend="hlo")
+
+    def _cover_infos(self, opts: ExecOpts, graph: _Graph
+                     ) -> List[CallInfo]:
+        """Per-call coverage: hashed (op, dtype, rank, pass-mask) n-gram
+        PCs.  A pure function of the instruction stream, so triage's
+        rerun-intersection keeps it (determinism is what makes the
+        admission dedup and prefix machinery behave identically to the
+        syscall frontend)."""
+        mask = graph.mask
+        infos: List[CallInfo] = []
+        prev_op = ""
+        for n in graph.nodes:
+            if n.op == "hlo_setup":
+                sig = [_pc("setup")]
+            elif n.op.startswith("hlo_pass_"):
+                sig = [_pc("pass", n.op, mask)]
+            else:
+                sig = [
+                    _pc("op", n.op),
+                    _pc("op", n.op, n.dtype, len(n.shape), mask),
+                    _pc("2gram", prev_op, n.op, mask),
+                ]
+                prev_op = n.op
+            infos.append(CallInfo(
+                index=n.idx, num=n.call_id, errno=0,
+                executed=True, fault_injected=False,
+                signal=sig if opts.collect_signal else [],
+                cover=list(sig) if opts.collect_cover else [],
+                comps=[]))
+        return infos

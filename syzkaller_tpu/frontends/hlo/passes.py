@@ -1,0 +1,123 @@
+"""Graph-level "compiler passes" over decoded hlo node graphs.
+
+Each pass is a semantics-preserving transform applied to the node graph
+before the optimized (JAX-compiled) execution — the un-optimized numpy
+reference always interprets the ORIGINAL graph, so any divergence a
+pass introduces is a real differential finding.  Pass selection is the
+bitmask of ``hlo_pass_*`` markers present in the program row, which is
+what makes the pass pipeline co-mutate and co-minimize with the IR.
+
+The transforms are deliberately modeled on the divergence surfaces real
+tensor compilers expose (Tzer's joint IR+pass findings): constant
+folding evaluates subgraphs at "compile time" with a different engine
+than the runtime, CSE/DCE rewire and drop nodes, reassociation changes
+float rounding within the comparator tolerance.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from .target import PASS_OPS
+
+# pass name -> bit position in the pass mask
+PASS_BITS: Dict[str, int] = {name: 1 << i for i, name in enumerate(PASS_OPS)}
+
+_BINARY_REASSOC = ("hlo_add", "hlo_mul")
+
+
+def pass_mask(names) -> int:
+    mask = 0
+    for n in names:
+        mask |= PASS_BITS.get(n, 0)
+    return mask
+
+
+def apply_passes(nodes: List, mask: int, evaluate) -> List:
+    """Return a transformed copy of ``nodes`` under the enabled passes.
+
+    ``evaluate(node, nodes)`` computes a node's value eagerly (numpy) —
+    the const-folding "compile-time evaluator".  Nodes are the executor's
+    ``Node`` records; transforms mutate copies, never the input list, so
+    the reference interpreter still sees the original graph.
+    """
+    out = [n.clone() for n in nodes]
+    if mask & PASS_BITS["fold"]:
+        _fold(out, evaluate)
+    if mask & PASS_BITS["cse"]:
+        _cse(out)
+    if mask & PASS_BITS["reassoc"]:
+        _reassoc(out)
+    if mask & PASS_BITS["dce"]:
+        _dce(out)
+    # "fuse" intentionally has no graph effect: it is a no-op marker that
+    # still participates in coverage n-grams and seeded-bug triggers, so
+    # campaigns explore pass *combinations* cheaply.
+    return out
+
+
+def _fold(nodes: List, evaluate) -> None:
+    """Constant folding: a node whose operands are all literal leaves is
+    evaluated now and replaced by a literal node."""
+    for n in nodes:
+        if n.op in ("hlo_const", "hlo_iota") or n.lit is not None:
+            continue
+        if n.srcs and all(nodes[s].lit is not None for s in n.srcs):
+            try:
+                n.lit = evaluate(n, nodes)
+                n.folded = True
+            except Exception:
+                pass  # unfoldable (e.g. div by zero path): leave live
+
+
+def _cse(nodes: List) -> None:
+    """Common-subexpression elimination: structurally identical nodes
+    collapse onto the first occurrence (consumers rewired)."""
+    seen: Dict[tuple, int] = {}
+    remap: Dict[int, int] = {}
+    for n in nodes:
+        srcs = tuple(remap.get(s, s) for s in n.srcs)
+        n.srcs = list(srcs)
+        key = n.structural_key()
+        if key in seen:
+            remap[n.idx] = seen[key]
+        else:
+            seen[key] = n.idx
+
+
+def _reassoc(nodes: List) -> None:
+    """Rotate (a ∘ b) ∘ c -> a ∘ (b ∘ c) for associative elementwise ops
+    when both operands resolve to same-op chains — changes float rounding
+    order (absorbed by the comparator tolerance) and exercises a rewrite
+    the optimizer alone performs."""
+    for n in nodes:
+        if n.op not in _BINARY_REASSOC or len(n.srcs) != 2:
+            continue
+        left = nodes[n.srcs[0]]
+        if left.op == n.op and len(left.srcs) == 2 and left.idx != n.idx:
+            # (a op b) op c  ->  swap so the right subtree deepens; the
+            # multiset of operands is unchanged
+            a, b = left.srcs
+            c = n.srcs[1]
+            n.srcs = [a, c]
+            n.reassoc_extra = b
+
+
+def _dce(nodes: List) -> None:
+    """Dead-code elimination: nodes unreachable from the graph outputs
+    are marked dead (the executor skips evaluating them in the optimized
+    run — a real effect once CSE has orphaned duplicates)."""
+    live = set()
+    stack = [n.idx for n in nodes if n.is_output]
+    while stack:
+        i = stack.pop()
+        if i in live:
+            continue
+        live.add(i)
+        n = nodes[i]
+        stack.extend(n.srcs)
+        if getattr(n, "reassoc_extra", None) is not None:
+            stack.append(n.reassoc_extra)
+    for n in nodes:
+        if n.idx not in live:
+            n.dead = True

@@ -1,0 +1,179 @@
+"""The ``hlo/xla`` target: StableHLO/XLA-style tensor ops as "syscalls".
+
+Each op is an ordinary ``prog.types.Syscall`` whose operands are typed
+with the existing arg-type machinery — tensor operands are a resource
+(``hlo_tensor``) threaded call-to-call exactly like an fd, dtype/shape
+selectors are ``FlagsType`` enums over small dense tables, reduce axes
+are ranged ints — so ``descriptions.tables.compile_tables`` flattens the
+whole table into the same fixed-width slot templates the kernel-fuzzing
+targets produce, and ``prog/tensor.py`` rows encode hlo programs with
+**zero codec changes**.
+
+The pass pipeline rides in the same row: the ``hlo_pass_*`` ops are
+zero-operand markers whose presence anywhere in the program enables the
+corresponding graph transform in the executor (frontends/hlo/passes.py).
+Because passes are just calls, ``ops/mutation.py`` jointly mutates IR
+and pass pipeline with zero kernel changes, and ``prog.mutation.minimize``
+shrinks the pass list by the same call-removal ladder it uses for ops —
+the Tzer joint IR+pass mutation story on unmodified machinery.
+
+``hlo_setup`` is the mmap analogue: the engine's prelude/codec/prio
+paths unconditionally consult ``target.mmap_syscall`` (the tensor codec
+strips/reinserts it, the device pipeline masks it), so the target
+supplies one even though the in-process executor has no address space
+to prepare — it decodes as a no-op setup marker.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ...prog import prog as progmod
+from ...prog.target import Target, register_target
+from ...prog.types import (
+    Dir,
+    FlagsType,
+    IntKind,
+    IntType,
+    LenType,
+    ResourceDesc,
+    ResourceType,
+    Syscall,
+    VmaType,
+)
+
+# ---- shared dtype / shape tables --------------------------------------
+# Selector args index these by value (the executor reduces mod len, so a
+# mutated selector always lands on a valid entry).  Small on purpose:
+# the coverage space is (op, dtype, rank, pass) n-grams and every entry
+# multiplies it.
+
+DTYPES: Tuple[str, ...] = ("f32", "i32", "u32")
+NP_DTYPES = (np.float32, np.int32, np.uint32)
+
+SHAPES: Tuple[Tuple[int, ...], ...] = (
+    (), (4,), (8,), (2, 3), (4, 4), (2, 2, 2), (1, 8), (3, 3),
+)
+
+MAX_RANK = max(len(s) for s in SHAPES)
+
+# Pass markers: op name suffix -> bit in the executor's pass mask.
+PASS_OPS: Tuple[str, ...] = ("fold", "cse", "dce", "reassoc", "fuse")
+
+_TENSOR = ResourceDesc(
+    name="hlo_tensor",
+    typ=IntType(name="int64", size=8),
+    kind=("hlo_tensor",),
+    values=(0,),
+)
+
+
+def _tin(fname: str) -> ResourceType:
+    return ResourceType(name="hlo_tensor", field_name=fname, size=8,
+                        dir=Dir.IN, desc=_TENSOR)
+
+
+_TOUT = ResourceType(name="hlo_tensor", size=8, dir=Dir.OUT, desc=_TENSOR)
+
+_DTYPE = FlagsType(name="hlo_dtype", field_name="dtype", size=8,
+                   vals=tuple(range(len(DTYPES))))
+_SHAPE = FlagsType(name="hlo_shape", field_name="shape", size=8,
+                   vals=tuple(range(len(SHAPES))))
+_AXIS = IntType(name="hlo_axis", field_name="axis", size=8,
+                kind=IntKind.RANGE, range_begin=0, range_end=MAX_RANK)
+_VAL = IntType(name="hlo_val", field_name="val", size=8)
+
+# (name, args, has_ret) — ids are dense list positions, nr == id (there
+# is no kernel ABI to match; the exec wire carries the dense id).
+_OP_SPECS = (
+    ("hlo_setup",
+     (VmaType(name="hlo_vma", field_name="addr", size=8,
+              range_begin=1, range_end=1),
+      LenType(name="len", field_name="len", size=8, buf="addr")),
+     False),
+    # leaves
+    ("hlo_const", (_DTYPE, _SHAPE, _VAL), True),
+    ("hlo_iota", (_DTYPE, _SHAPE), True),
+    # elementwise unary
+    ("hlo_neg", (_tin("t"),), True),
+    ("hlo_abs", (_tin("t"),), True),
+    ("hlo_tanh", (_tin("t"),), True),
+    ("hlo_exp", (_tin("t"),), True),
+    # elementwise binary
+    ("hlo_add", (_tin("a"), _tin("b")), True),
+    ("hlo_sub", (_tin("a"), _tin("b")), True),
+    ("hlo_mul", (_tin("a"), _tin("b")), True),
+    ("hlo_max", (_tin("a"), _tin("b")), True),
+    ("hlo_min", (_tin("a"), _tin("b")), True),
+    ("hlo_div", (_tin("a"), _tin("b")), True),
+    # reductions
+    ("hlo_reduce_sum", (_tin("t"), _AXIS), True),
+    ("hlo_reduce_max", (_tin("t"), _AXIS), True),
+    # contraction
+    ("hlo_dot", (_tin("a"), _tin("b")), True),
+    # shape ops
+    ("hlo_reshape", (_tin("t"), _SHAPE), True),
+    ("hlo_broadcast", (_tin("t"), _SHAPE), True),
+    ("hlo_convert", (_tin("t"), _DTYPE), True),
+    # control / selection
+    ("hlo_select", (_tin("p"), _tin("a"), _tin("b")), True),
+    ("hlo_clamp", (_tin("lo"), _tin("x"), _tin("hi")), True),
+) + tuple(
+    # pass-pipeline markers: zero-operand, no result — pure row payload
+    (f"hlo_pass_{p}", (), False) for p in PASS_OPS
+)
+
+
+def build_target() -> Target:
+    syscalls = [
+        Syscall(id=i, nr=i, name=name, call_name=name, args=args,
+                ret=_TOUT if has_ret else None)
+        for i, (name, args, has_ret) in enumerate(_OP_SPECS)
+    ]
+    target = Target("hlo", "xla", page_size=4096, num_pages=16,
+                    revision="hlo-1", syscalls=syscalls,
+                    resources=[_TENSOR])
+    _init_arch(target)
+    return target
+
+
+def _init_arch(target: Target) -> None:
+    """Arch hooks mirroring descriptions/fuchsia: hlo_setup is the mmap
+    analogue the codec prelude and device pipeline require."""
+    mmap = target.syscall_map["hlo_setup"]
+
+    def make_mmap(start: int, npages: int) -> progmod.Call:
+        return progmod.Call(
+            meta=mmap,
+            args=[
+                progmod.PointerArg(mmap.args[0], start, 0, npages, None),
+                progmod.ConstArg(mmap.args[1], npages * target.page_size),
+            ],
+            ret=progmod.ReturnArg(None),
+        )
+
+    def analyze_mmap(c: progmod.Call):
+        if c.meta.name == "hlo_setup":
+            npages = c.args[1].val // target.page_size
+            return c.args[0].page_index, npages, npages > 0
+        return 0, 0, False
+
+    target.mmap_syscall = mmap
+    target.make_mmap = make_mmap
+    target.analyze_mmap = analyze_mmap
+
+
+_target: Optional[Target] = None
+
+
+def ensure_registered() -> Target:
+    """Build + register the hlo/xla target once per process (the prog
+    registry rejects duplicates; the compiled-table cache keys on the
+    target object, so everyone must share one instance)."""
+    global _target
+    if _target is None:
+        _target = build_target()
+        register_target(_target)
+    return _target

@@ -1,0 +1,30 @@
+"""The ``syscall`` frontend: the original kernel-fuzzing configuration.
+
+Target construction goes through ``prog.get_target`` (bundled OS
+descriptions), env construction replicates the engine's historical loop
+verbatim: ``MockEnv`` (hermetic, prefix-continuation-capable) when
+``cfg.mock``, the real ``ipc.Env`` executor otherwise.  This file is the
+parity anchor — tests/test_frontends.py pins that a campaign built
+through this frontend is bit-identical to the pre-registry engine, so
+the registry indirection can never drift for the default path.
+"""
+
+from __future__ import annotations
+
+from ..ipc import Env, EnvConfig, MockEnv
+from ..prog import get_target
+
+
+class SyscallFrontend:
+    name = "syscall"
+    description = "kernel syscall fuzzing (bundled OS descriptions + ipc.Env)"
+
+    def make_target(self, os: str = "linux", arch: str = "amd64"):
+        return get_target(os, arch)
+
+    def make_env(self, target, pid: int, cfg):
+        if cfg.mock:
+            return MockEnv(target, pid=pid,
+                           prefix_cache_entries=cfg.prefix_cache_entries)
+        ec = cfg.env_config or EnvConfig(sandbox=cfg.sandbox)
+        return Env(target, pid=pid, config=ec)

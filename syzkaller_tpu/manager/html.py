@@ -26,7 +26,20 @@ from ..telemetry import get_ledger, get_registry, get_tracer, rate_points
 # /stats.json wire-shape version: the fleet aggregator (manager/fleet.py)
 # and any external scraper key off this — bump it whenever a top-level
 # key is added/removed/retyped (tests/test_fleet.py pins the shape)
-STATS_SCHEMA_VERSION = 1
+# v2: added the top-level "frontend" block (compiler-frontend counters)
+STATS_SCHEMA_VERSION = 2
+
+# compiler-frontend counters surfaced as the /stats.json "frontend"
+# block and the /dashboard "compiler frontend" table; zero-defaulted so
+# the block exists (all zeros) in syscall-frontend and manager-only
+# processes — scrapers never need a presence check
+FRONTEND_METRICS = (
+    "frontend_compiles_total",
+    "frontend_compile_cache_hits_total",
+    "frontend_miscompares_total",
+    "frontend_exceptions_total",
+    "frontend_exec_timeouts_total",
+)
 
 _STYLE = """
 <style>
@@ -338,7 +351,9 @@ class ManagerHttp:
         sampler = getattr(self.mgr, "sampler", None)
         att_state = getattr(self.mgr, "attribution_state", None)
         engines = getattr(self.mgr, "engines_info", None)
+        reg_snap = get_registry().snapshot()
         payload = {
+            "frontend": {k: reg_snap.get(k, 0) for k in FRONTEND_METRICS},
             "schema_version": STATS_SCHEMA_VERSION,
             "engine_id": getattr(self.mgr, "engine_id", None),
             "name": self.mgr.cfg.name,
@@ -460,6 +475,21 @@ class ManagerHttp:
         if pfx:
             parts.append("<h2>prefix memoization</h2>"
                          + _table(["metric", "value"], pfx))
+
+        # compiler frontend (ISSUE 16): the hlo differential executor's
+        # compile economy (cache hit rate is the execs/sec lever) and
+        # its findings by failure mode.  The counters only register when
+        # an HloEnv exists, so syscall-only campaigns skip the section.
+        fr = [[k, _fmt_num(snap[k])] for k in FRONTEND_METRICS
+              if k in snap]
+        fc = first_moving("frontend_compiles_total")
+        fh = first_moving("frontend_compile_cache_hits_total")
+        if fc or fh:
+            fr.append(["compile_cache_hit_rate",
+                       _fmt_num(round(fh / (fh + fc), 3))])
+        if fr:
+            parts.append("<h2>compiler frontend</h2>"
+                         + _table(["metric", "value"], fr))
 
         # fused signal path (ISSUE 8): cover merges through the fused
         # merge+new entry vs silent host fallback off the pallas path,

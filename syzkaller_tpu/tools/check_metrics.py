@@ -97,6 +97,15 @@ REQUIRED_METRICS = (
     "journal_bytes_total",
     "fleet_scrape_errors_total",
     "fleet_engines_online",
+    # compiler frontend (ISSUE 16): the hlo differential executor's
+    # compile economy and findings-by-failure-mode must stay visible —
+    # the /stats.json "frontend" block and the dashboard table read
+    # these, and the bench hlo_e2e config derives its hit rate from them
+    "frontend_compiles_total",
+    "frontend_compile_cache_hits_total",
+    "frontend_miscompares_total",
+    "frontend_exceptions_total",
+    "frontend_exec_timeouts_total",
 )
 
 

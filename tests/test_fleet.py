@@ -58,8 +58,14 @@ def test_stats_json_schema_pinned(tmp_path, target):
     assert set(doc) == {
         "schema_version", "engine_id", "name", "now", "interval",
         "samples", "series", "attribution", "attribution_state",
-        "engines", "snapshot"}
-    assert doc["schema_version"] == STATS_SCHEMA_VERSION == 1
+        "engines", "snapshot", "frontend"}
+    assert doc["schema_version"] == STATS_SCHEMA_VERSION == 2
+    # the frontend block always exists, zero-defaulted, with the exact
+    # counter set the dashboard's "compiler frontend" table reads
+    from syzkaller_tpu.manager.html import FRONTEND_METRICS
+
+    assert set(doc["frontend"]) == set(FRONTEND_METRICS)
+    assert all(isinstance(v, (int, float)) for v in doc["frontend"].values())
     # the manager's identity is the workdir-minted persistent id
     assert doc["engine_id"] == \
         (tmp_path / "engine_id").read_text().strip()
@@ -171,10 +177,10 @@ def test_load_state_restart_continuation_is_monotonic():
 
 def _doc(name, snapshot, engine_id="eng-x", att=None):
     return {
-        "schema_version": 1, "engine_id": engine_id, "name": name,
+        "schema_version": 2, "engine_id": engine_id, "name": name,
         "now": time.time(), "interval": 0, "samples": 1, "series": {},
         "attribution": {}, "attribution_state": att,
-        "engines": {}, "snapshot": snapshot,
+        "engines": {}, "snapshot": snapshot, "frontend": {},
     }
 
 

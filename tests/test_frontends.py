@@ -1,0 +1,477 @@
+"""Frontend registry + hlo compiler-fuzzing frontend tests (ISSUE 16).
+
+Pins the headline claims of the frontends subsystem:
+
+  - refactor guard: the default ``syscall`` frontend path through the
+    registry is behaviorally identical to the pre-registry engine (same
+    env types, and two seeded MockEnv campaigns — default config vs
+    explicit ``frontend="syscall"`` — produce bit-identical corpus and
+    signal);
+  - the hlo target compiles through the UNCHANGED table/tensor codec
+    stack (slot templates, fixed-width rows, encode/decode round trips);
+  - the in-process differential executor: deterministic coverage,
+    structural compile-cache hits, seeded miscompare/exception bugs
+    reported as crash-PCs through the existing paths, and bug triggers
+    that require op AND pass so minimization provably keeps both;
+  - CLI: unknown ``--frontend`` dies at parse time with the registry's
+    name list (exit 2);
+  - e2e: a short CPU campaign on the stock device pipeline finds,
+    triages, and journals every seeded differential bug with coverage
+    growing and admission deduping — arena/admission/supervision/journal
+    code paths unchanged, asserted via the existing metrics.
+"""
+
+import json
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from syzkaller_tpu import frontends
+from syzkaller_tpu.descriptions.tables import get_tables
+from syzkaller_tpu.engine.fuzzer import Fuzzer, FuzzerConfig, ManagerConn
+from syzkaller_tpu.frontends.hlo import bugs as hbugs
+from syzkaller_tpu.frontends.hlo.executor import HloEnv, _pc
+from syzkaller_tpu.ipc import ExecOpts, MockEnv
+from syzkaller_tpu.prog import get_target
+from syzkaller_tpu.prog import prog as pm
+from syzkaller_tpu.prog.encoding import serialize
+from syzkaller_tpu.prog.encodingexec import serialize_for_exec
+from syzkaller_tpu.prog.generation import generate
+from syzkaller_tpu.prog.mutation import minimize
+from syzkaller_tpu.prog.prog import Prog
+from syzkaller_tpu.prog.tensor import TensorFormat, decode_prog, encode_prog
+from syzkaller_tpu.telemetry import get_registry
+
+
+@pytest.fixture(scope="module")
+def hlo_target():
+    return frontends.get("hlo").make_target()
+
+
+@pytest.fixture(autouse=True)
+def _no_bug_plan():
+    hbugs.clear()
+    yield
+    hbugs.clear()
+
+
+def _result_arg(typ, producer_call):
+    a = pm.ResultArg(typ, res=producer_call.ret, val=0)
+    producer_call.ret.uses.add(a)
+    return a
+
+
+def _call(meta, *args):
+    return pm.Call(meta=meta, args=list(args),
+                   ret=pm.ReturnArg(meta.ret) if meta.ret else None)
+
+
+def _trigger_prog(t, op_name: str, pass_name: str, junk: int = 0) -> Prog:
+    """const leaf -> trigger op -> pass marker, plus optional junk calls
+    (independent iota/neg chains and an extra pass) minimization must be
+    able to drop."""
+    const = t.syscall_map["hlo_const"]
+    op = t.syscall_map[op_name]
+    leaf = _call(const, pm.ConstArg(const.args[0], 0),
+                 pm.ConstArg(const.args[1], 3), pm.ConstArg(const.args[2], 7))
+    args = []
+    for at in op.args:
+        if at.name == "hlo_tensor":
+            args.append(_result_arg(at, leaf))
+        else:
+            args.append(pm.ConstArg(at, 1))
+    trig = _call(op, *args)
+    calls = [leaf, trig, _call(t.syscall_map[pass_name])]
+    for j in range(junk):
+        iota = t.syscall_map["hlo_iota"]
+        neg = t.syscall_map["hlo_neg"]
+        jleaf = _call(iota, pm.ConstArg(iota.args[0], j % 3),
+                      pm.ConstArg(iota.args[1], (j + 1) % 8))
+        calls.append(jleaf)
+        calls.append(_call(neg, _result_arg(neg.args[0], jleaf)))
+    if junk:
+        calls.append(_call(t.syscall_map["hlo_pass_dce"]))
+    return Prog(target=t, calls=calls)
+
+
+# ---- registry + CLI ---------------------------------------------------
+
+
+def test_registry_names_and_lookup():
+    assert set(frontends.names()) >= {"syscall", "hlo"}
+    assert frontends.get("syscall").name == "syscall"
+    with pytest.raises(KeyError) as ei:
+        frontends.get("nope")
+    # the error carries the full name list (the CLI quotes it)
+    assert "syscall" in str(ei.value) and "hlo" in str(ei.value)
+
+
+def test_cli_rejects_unknown_frontend():
+    """Unknown --frontend must die at argument-parse time with exit 2
+    and the registry's name list — not an AttributeError at first
+    batch."""
+    r = subprocess.run(
+        [sys.executable, "-m", "syzkaller_tpu.engine",
+         "--frontend", "bogus", "-mock", "-iterations", "1"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 2
+    assert "unknown frontend 'bogus'" in r.stderr
+    assert "syscall" in r.stderr and "hlo" in r.stderr
+
+
+def test_unknown_frontend_config_raises_before_envs():
+    t = get_target("linux", "amd64")
+    with pytest.raises(KeyError):
+        Fuzzer(t, FuzzerConfig(mock=True, use_device=False,
+                               frontend="bogus"))
+
+
+# ---- refactor guard: syscall-frontend parity --------------------------
+
+
+def _mock_campaign(explicit_frontend: bool, seed: int = 9):
+    t = get_target("linux", "amd64")
+    kw = {"frontend": "syscall"} if explicit_frontend else {}
+    cfg = FuzzerConfig(mock=True, use_device=False, procs=2,
+                       program_length=8, prefix_cache_entries=64, **kw)
+    f = Fuzzer(t, cfg, seed=seed)
+    for _ in range(60):
+        f.step()
+    out = (sorted(serialize(p) for p in f.corpus),
+           set(f.max_signal),
+           [type(e).__name__ for e in f.envs],
+           [e.prefix_cache_entries for e in f.envs])
+    f.close()
+    return out
+
+
+def test_syscall_frontend_parity_with_default_path():
+    """The registry indirection must be invisible: a seeded MockEnv
+    campaign through the default config and one explicitly selecting
+    frontend="syscall" produce identical corpus, signal, and envs."""
+    corpus_a, sig_a, envs_a, pce_a = _mock_campaign(False)
+    corpus_b, sig_b, envs_b, pce_b = _mock_campaign(True)
+    assert envs_a == envs_b == ["MockEnv", "MockEnv"]
+    assert pce_a == pce_b == [64, 64]  # cfg plumbing reaches the env
+    assert corpus_a == corpus_b
+    assert sig_a == sig_b
+    assert len(corpus_a) > 0
+
+
+def test_syscall_frontend_env_construction_matches_pre_refactor():
+    """make_env replicates the historical loop verbatim: MockEnv under
+    cfg.mock with the configured prefix cache bound."""
+    t = get_target("linux", "amd64")
+    fe = frontends.get("syscall")
+    cfg = FuzzerConfig(mock=True, prefix_cache_entries=17)
+    env = fe.make_env(t, 3, cfg)
+    assert isinstance(env, MockEnv)
+    assert env.pid == 3 and env.prefix_cache_entries == 17
+
+
+# ---- hlo target through the unchanged codec stack ---------------------
+
+
+def test_hlo_target_builds_and_compiles_tables(hlo_target):
+    t = hlo_target
+    assert t.os == "hlo" and t.arch == "xla"
+    assert t.mmap_syscall is not None
+    assert t.mmap_syscall.name == "hlo_setup"
+    assert "hlo_tensor" in t.resource_map
+    # pass markers present and distinct ops
+    names = {c.name for c in t.syscalls}
+    assert {"hlo_dot", "hlo_pass_fold", "hlo_pass_cse"} <= names
+    tables = get_tables(t)
+    assert tables.n_calls == len(t.syscalls)
+    # every tensor op can be constructed: the resource has ctors
+    assert t.resource_ctors["hlo_tensor"]
+
+
+def test_hlo_generate_and_serialize_roundtrip(hlo_target):
+    from syzkaller_tpu.prog.encoding import deserialize
+
+    for seed in range(10):
+        p = generate(hlo_target, seed, 10, None)
+        text = serialize(p)
+        p2 = deserialize(hlo_target, text)
+        assert serialize(p2) == text
+        assert serialize_for_exec(p2, 0)
+
+
+def test_hlo_tensor_row_roundtrip(hlo_target):
+    """hlo programs ride the SAME fixed-width row encoding: encode ->
+    decode preserves the op sequence (pass markers included), and
+    decode -> encode is a fixed point."""
+    t = hlo_target
+    tables = get_tables(t)
+    fmt = TensorFormat.for_tables(tables)
+    for seed in range(10):
+        p = generate(t, seed, 10, None)
+        b = encode_prog(tables, fmt, p)
+        q = decode_prog(tables, fmt, b, 0)
+        q.validate()
+        mmap = t.mmap_syscall
+        orig = [c.meta.name for c in p.calls if c.meta is not mmap]
+        got = [c.meta.name for c in q.calls if c.meta is not mmap]
+        assert got == orig[: fmt.max_calls]
+        b2 = encode_prog(tables, fmt, q)
+        assert np.array_equal(b.call_id, b2.call_id)
+        assert np.array_equal(b.slot_val, b2.slot_val)
+
+
+# ---- the differential executor ---------------------------------------
+
+
+def test_hlo_executor_coverage_deterministic(hlo_target):
+    """Per-call coverage is a pure function of the instruction stream:
+    two envs, repeated execs — identical signal, clean exits."""
+    env1 = HloEnv(hlo_target, pid=0)
+    env2 = HloEnv(hlo_target, pid=1)
+    for seed in range(6):
+        p = generate(hlo_target, seed, 8, None)
+        _, i1, f1, h1 = env1.exec(ExecOpts(), p)
+        _, i2, f2, h2 = env2.exec(ExecOpts(), p)
+        _, i3, _, _ = env1.exec(ExecOpts(), p)
+        assert not f1 and not h1 and not f2 and not h2
+        assert [c.signal for c in i1] == [c.signal for c in i2]
+        assert [c.signal for c in i1] == [c.signal for c in i3]
+        assert len(i1) == len(p.calls)
+
+
+def test_hlo_compile_cache_hits_on_same_structure(hlo_target):
+    env = HloEnv(hlo_target, pid=0)
+    reg = get_registry()
+    p = _trigger_prog(hlo_target, "hlo_add", "hlo_pass_cse")
+    env.exec(ExecOpts(), p)
+    before = reg.snapshot()
+    env.exec(ExecOpts(), p)
+    after = reg.snapshot()
+    assert after["frontend_compile_cache_hits_total"] \
+        == before["frontend_compile_cache_hits_total"] + 1
+    assert after["frontend_compiles_total"] \
+        == before["frontend_compiles_total"]
+
+
+def test_seeded_miscompare_reported_as_crash_signal(hlo_target):
+    """A seeded miscompare surfaces as errno + a distinctive crash PC on
+    the TRIGGER call, with failed=False so the engine's triage scans it
+    instead of discarding the program."""
+    plan = hbugs.BugPlan(bugs=(
+        hbugs.SeededBug(name="x", op="hlo_dot",
+                        pass_name="hlo_pass_fold"),))
+    hbugs.install(plan)
+    env = HloEnv(hlo_target, pid=0)
+    reg = get_registry()
+    before = reg.snapshot().get("frontend_miscompares_total", 0)
+
+    p = _trigger_prog(hlo_target, "hlo_dot", "hlo_pass_fold")
+    _, infos, failed, hanged = env.exec(ExecOpts(), p)
+    assert not failed and not hanged
+    assert infos[1].errno == 5  # the hlo_dot call
+    assert _pc("bug", "hlo-seeded-x") in infos[1].signal
+    assert plan.fired_names() == {"x"}
+    assert reg.snapshot()["frontend_miscompares_total"] == before + 1
+
+    # content-determinism: reruns (triage) reproduce it identically
+    _, infos2, _, _ = env.exec(ExecOpts(), p)
+    assert infos2[1].errno == 5
+    assert _pc("bug", "hlo-seeded-x") in infos2[1].signal
+
+
+def test_seeded_bug_requires_both_op_and_pass(hlo_target):
+    plan = hbugs.BugPlan(bugs=(
+        hbugs.SeededBug(name="x", op="hlo_dot",
+                        pass_name="hlo_pass_fold"),))
+    hbugs.install(plan)
+    env = HloEnv(hlo_target, pid=0)
+    # op without the pass: no fire
+    p = _trigger_prog(hlo_target, "hlo_dot", "hlo_pass_cse")
+    _, infos, _, _ = env.exec(ExecOpts(), p)
+    assert all(i.errno == 0 for i in infos)
+    # pass without the op: no fire
+    p = _trigger_prog(hlo_target, "hlo_add", "hlo_pass_fold")
+    _, infos, _, _ = env.exec(ExecOpts(), p)
+    assert all(i.errno == 0 for i in infos)
+    assert plan.fired() == []
+
+
+def test_seeded_exception_and_timeout_kinds(hlo_target):
+    plan = hbugs.BugPlan(bugs=(
+        hbugs.SeededBug(name="boom", op="hlo_neg", kind="exception"),
+        hbugs.SeededBug(name="hang", op="hlo_abs", kind="timeout"),))
+    hbugs.install(plan)
+    env = HloEnv(hlo_target, pid=0)
+    reg = get_registry()
+    b = reg.snapshot()
+
+    p = _trigger_prog(hlo_target, "hlo_neg", "hlo_pass_fuse")
+    _, infos, failed, _ = env.exec(ExecOpts(), p)
+    assert not failed and infos[1].errno == 5
+    p = _trigger_prog(hlo_target, "hlo_abs", "hlo_pass_fuse")
+    _, infos, failed, _ = env.exec(ExecOpts(), p)
+    assert not failed and infos[1].errno == 5
+
+    a = reg.snapshot()
+    assert a["frontend_exceptions_total"] == b.get(
+        "frontend_exceptions_total", 0) + 1
+    assert a["frontend_exec_timeouts_total"] == b.get(
+        "frontend_exec_timeouts_total", 0) + 1
+    assert plan.fired_names() == {"boom", "hang"}
+
+
+def test_hlo_env_death_site_keeps_supervision_contract(hlo_target):
+    """The testing/faults.py env.exec:<pid> site works unchanged: the
+    env reports failed like a crashed executor and counts a restart —
+    the drain supervisor path needs no frontend-specific code."""
+    from syzkaller_tpu.testing import faults
+
+    faults.install(faults.FaultPlan().fail_at("env.exec:0", 1))
+    try:
+        env = HloEnv(hlo_target, pid=0)
+        p = _trigger_prog(hlo_target, "hlo_add", "hlo_pass_cse")
+        _, infos, failed, hanged = env.exec(ExecOpts(), p)
+        assert failed and not hanged and infos == []
+        assert env.restarts == 1
+        _, infos, failed, _ = env.exec(ExecOpts(), p)
+        assert not failed and len(infos) == len(p.calls)
+    finally:
+        faults.clear()
+
+
+def test_minimize_shrinks_ops_and_pass_list(hlo_target):
+    """The acceptance property: minimization against a seeded (op, pass)
+    bug drops the junk op chains AND the junk pass markers but must keep
+    both the trigger op and its required pass — the joint IR+pass row
+    minimizes through the stock call-removal ladder."""
+    plan = hbugs.BugPlan(bugs=(
+        hbugs.SeededBug(name="x", op="hlo_dot",
+                        pass_name="hlo_pass_fold"),))
+    hbugs.install(plan)
+    env = HloEnv(hlo_target, pid=0)
+    t = hlo_target
+
+    p = _trigger_prog(t, "hlo_dot", "hlo_pass_fold", junk=3)
+    names0 = [c.meta.name for c in p.calls]
+    assert "hlo_pass_dce" in names0 and names0.count("hlo_neg") == 3
+    crash_pc = _pc("bug", "hlo-seeded-x")
+    trig_idx = names0.index("hlo_dot")
+
+    def pred(p1, ci):
+        _, infos, failed, hanged = env.exec(ExecOpts(), p1)
+        if failed or hanged or not (0 <= ci < len(infos)):
+            return False
+        return crash_pc in infos[ci].signal
+
+    assert pred(p, trig_idx)  # the bug reproduces before minimizing
+    p2, idx = minimize(p, trig_idx, pred)
+    names = [c.meta.name for c in p2.calls]
+    assert len(p2.calls) < len(p.calls)
+    assert p2.calls[idx].meta.name == "hlo_dot"
+    assert "hlo_pass_fold" in names      # the required pass survives
+    assert "hlo_pass_dce" not in names   # the junk pass is gone
+    assert "hlo_neg" not in names        # the junk op chains are gone
+
+
+# ---- e2e: seeded bugs through the stock engine ------------------------
+
+
+@pytest.mark.hlo
+def test_hlo_e2e_campaign_finds_triages_journals_seeded_bugs(tmp_path):
+    """A short CPU campaign on the UNCHANGED device pipeline: all seeded
+    differential bugs found (crash PCs triaged into max_signal, crash
+    records journaled), coverage growing and admission deduping across
+    batches — asserted via the existing metrics only."""
+    fe = frontends.get("hlo")
+    t = fe.make_target()
+    plan = hbugs.default_plan()
+    hbugs.install(plan)
+    reg = get_registry()
+    before = reg.snapshot()
+
+    # seed corpus: near-trigger programs (trigger op + pass + junk), the
+    # role of syzkaller's seed corpus — the campaign still has to
+    # execute, triage, minimize, and journal them through the stock
+    # paths, and mutation explores around them
+    seeds = [serialize(_trigger_prog(t, b.op, b.pass_name or
+                                     "hlo_pass_fuse", junk=2))
+             for b in plan.bugs]
+
+    class SeedConn(ManagerConn):
+        def connect(self):
+            d = super().connect()
+            d["candidates"] = seeds
+            return d
+
+    cfg = FuzzerConfig(frontend="hlo", use_device=True, device_batch=8,
+                       device_period=4, procs=1, program_length=6,
+                       smash_mutations=1, triage_reruns=2,
+                       workdir=str(tmp_path))
+    f = Fuzzer(t, cfg, manager=SeedConn(), seed=3)
+    want = {f"hlo-seeded-{b.name}" for b in plan.bugs}
+    want_pcs = {_pc("bug", title) for title in want}
+    sig_first_batch = None
+    deadline = time.time() + 150
+    try:
+        while time.time() < deadline:
+            f.step()
+            if sig_first_batch is None and f.stats["device_batches"]:
+                sig_first_batch = len(f.max_signal)
+            if want_pcs <= f.max_signal and f.stats["device_batches"] > 1:
+                break
+
+        # found: every seeded bug fired and its crash PC was triaged
+        # into the campaign's signal
+        assert plan.fired_names() == {b.name for b in plan.bugs}
+        assert want_pcs <= f.max_signal
+        assert f.stats["new_inputs"] > 0 and f.stats["exec_triage"] > 0
+
+        # journaled through the existing crash path
+        records = [json.loads(line) for line in
+                   (tmp_path / "journal.jsonl").read_text().splitlines()]
+        crash_titles = {r["title"] for r in records if r["ev"] == "crash"}
+        assert want <= crash_titles
+
+        # stock machinery moved, no forks: admission dedup + device
+        # batches + journal volume via the existing metrics; env
+        # supervision untouched (no restarts in a healthy campaign)
+        after = reg.snapshot()
+        assert after["candidates_admitted_total"] > \
+            before.get("candidates_admitted_total", 0)
+        assert after["candidates_deduped_total"] >= \
+            before.get("candidates_deduped_total", 0)
+        assert after["journal_records_total"] > \
+            before.get("journal_records_total", 0)
+        assert f.stats["device_batches"] > 1
+        assert all(e.restarts == 0 for e in f.envs)
+        # coverage kept growing after the first device batch
+        assert sig_first_batch is not None
+        assert len(f.max_signal) > sig_first_batch
+    finally:
+        f.close()
+
+
+@pytest.mark.hlo
+@pytest.mark.slow
+def test_hlo_organic_campaign_soak():
+    """Fully organic (no seed corpus): random generation + device
+    mutation alone find every seeded differential bug."""
+    fe = frontends.get("hlo")
+    t = fe.make_target()
+    plan = hbugs.default_plan()
+    hbugs.install(plan)
+    cfg = FuzzerConfig(frontend="hlo", use_device=True, device_batch=8,
+                       device_period=4, procs=1, program_length=8,
+                       smash_mutations=2, triage_reruns=2)
+    f = Fuzzer(t, cfg, seed=5)
+    deadline = time.time() + 600
+    try:
+        while time.time() < deadline:
+            f.step()
+            if len(plan.fired_names()) == len(plan.bugs):
+                break
+        assert plan.fired_names() == {b.name for b in plan.bugs}
+    finally:
+        f.close()

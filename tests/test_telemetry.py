@@ -467,6 +467,14 @@ def test_bench_json_line_schema(monkeypatch, capsys):
     monkeypatch.setattr(bench, "bench_e2e",
                         lambda target: ((40.0, 400, 4, dev_eff),
                                         (4.0, 40, 2, {}), "mock"))
+    # compiler-frontend e2e (ISSUE 16): the real body is import-guarded
+    # so pre-frontends engines report nulls through the same harness
+    monkeypatch.setattr(
+        bench, "bench_hlo_e2e",
+        lambda: {"execs_per_sec": 25.0, "execs": 250, "new_inputs": 5,
+                 "compile_cache_hit_rate": 0.5, "miscompares_found": 1,
+                 "exceptions_found": 1, "timeouts_found": 0,
+                 "bugs_fired": ["fold-dot-miscompare"], "seeded": 3})
     monkeypatch.setattr(
         bench, "bench_prefix_sweep",
         lambda target: {f"len{n}": {
@@ -504,6 +512,10 @@ def test_bench_json_line_schema(monkeypatch, capsys):
     # durability layer's cost is visible in BENCH deltas)
     assert e2e["efficiency"]["device"]["journal_records"] == 12
     assert e2e["efficiency"]["host"] == {}
+    # compiler-frontend config rides the same line (ISSUE 16)
+    hlo = doc["configs"]["hlo_e2e"]
+    assert hlo["compile_cache_hit_rate"] == 0.5
+    assert hlo["miscompares_found"] == 1 and hlo["seeded"] == 3
     sweep = doc["configs"]["arena_sweep"]
     for cap in bench.ARENA_SWEEP_CAPACITIES:
         assert "execs_per_new_input" in sweep[str(cap)]
@@ -523,8 +535,8 @@ def test_bench_json_line_schema(monkeypatch, capsys):
     assert mb["batched"]["serial_roundtrips_per_item"] < \
         mb["sequential"]["serial_roundtrips_per_item"]
     for name in ("mutate", "cover_merge_sweep", "minimize_bisect",
-                 "hints_100k", "e2e_triage", "arena_sweep", "hub_sync",
-                 "prefix_depth_sweep"):
+                 "hints_100k", "e2e_triage", "hlo_e2e", "arena_sweep",
+                 "hub_sync", "prefix_depth_sweep"):
         cfg = doc["configs"][name]
         assert "error" not in cfg
         spans = cfg["spans"]
