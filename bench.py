@@ -34,6 +34,12 @@ Configs (BASELINE.json "configs"):
                     {off, on} at EQUAL env count: executed calls per
                     batch/exec, prefix hit rate, and the off->on call
                     reduction (the prefix-memoized execution claim)
+  pipeline_depth_sweep — the e2e device loop over pipeline_depth
+                    {1, 2, 4} x device_batch {256, 512} at equal env
+                    count: execs/sec, stall rate, and the device.step /
+                    batch_drain span-overlap ratio per cell (depth 1 =
+                    the old lockstep double buffer, the in-harness
+                    baseline every deeper cell is judged against)
 
 The e2e-style configs report execs-per-new-input (yield efficiency)
 next to execs/sec: admission/scheduling wins show up as fewer wasted
@@ -437,7 +443,12 @@ def bench_e2e(target, seconds=18.0):
                 prefix=f"syztpu-e2e-{'dev' if use_device else 'host'}-"))
         with Fuzzer(target, cfg) as f:
             rate, execs, ni, delta = _timed_loop(f, seconds, reg)
-            return rate, execs, ni, _exec_efficiency(delta, execs)
+            eff = _exec_efficiency(delta, execs)
+            if use_device:
+                # the pipelined-step honesty numbers ride the device
+                # cell (null-tolerant on pre engines)
+                eff = {**eff, **_pipeline_overlap(delta)}
+            return rate, execs, ni, eff
 
     cwd = os.getcwd()
     work = tempfile.mkdtemp(prefix="syztpu-bench-")
@@ -496,6 +507,79 @@ def bench_arena_sweep(target, seconds=6.0):
                 "arena_weighted_evictions_total": (
                     getattr(arena, "weighted_evictions", 0)
                     if arena is not None else None),
+            }
+    return out
+
+
+# ------------------------------------------------------------------ #
+# config: async pipelined device step sweep (ISSUE 18)
+
+PIPELINE_SWEEP_DEPTHS = (1, 2, 4)
+PIPELINE_SWEEP_BATCHES = (256, 512)
+
+
+def _pipeline_overlap(delta):
+    """Pipeline honesty numbers of one timed window, null-tolerant for
+    engines predating the pipelined step's telemetry (the pre harness).
+
+      stall_rate    — consumes that blocked on an incomplete transfer
+                      over all consumes (device.fuzz_step.sync count).
+      overlap_ratio — sum of per-slot device.step spans (launch ->
+                      consume, OVERLAPPING at depth>=2) over the drain's
+                      elapsed device.batch_drain time; > 1 means the
+                      device was mutating while the host drained — the
+                      pipelining claim, measured, not asserted.
+    """
+    syncs = delta.get("span_device_fuzz_step_sync_seconds_count", 0)
+    stalls = delta.get("device_pipeline_stalls_total", 0)
+    step_sum = delta.get("span_device_step_seconds_sum", 0.0)
+    drain_sum = delta.get("span_device_batch_drain_seconds_sum", 0.0)
+    return {
+        "stall_rate": (round(stalls / syncs, 3) if syncs else None),
+        "stalls": stalls if syncs else None,
+        "overlap_ratio": (round(step_sum / drain_sum, 3)
+                          if (step_sum and drain_sum) else None),
+        "inflight_end": delta.get("device_pipeline_inflight") or None,
+    }
+
+
+def bench_pipeline_depth_sweep(target, seconds=6.0):
+    """The e2e device loop over pipeline_depth {1, 2, 4} x device_batch
+    {256, 512} at EQUAL env count, hermetic MockEnv fleet (the sweep
+    compares the launch ring against itself, not executor speed):
+    execs/sec, stall rate, and the span-overlap ratio per cell.  Depth 1
+    is the old lockstep double buffer — the in-harness baseline every
+    deeper cell is judged against.  Config construction is
+    dataclasses-tolerant so the SAME harness runs pre+post: a pre-PR
+    engine has no pipeline_depth knob (only its lockstep pipeline runs,
+    reported as the d1 cells; deeper cells are null)."""
+    import dataclasses
+
+    from syzkaller_tpu.engine.fuzzer import Fuzzer, FuzzerConfig
+    from syzkaller_tpu.telemetry import get_registry
+
+    reg = get_registry()
+    has_knob = "pipeline_depth" in {
+        fld.name for fld in dataclasses.fields(FuzzerConfig)}
+    out = {"has_pipeline_depth": has_knob}
+    for batch in PIPELINE_SWEEP_BATCHES:
+        for depth in PIPELINE_SWEEP_DEPTHS:
+            cell_name = f"b{batch}_d{depth}"
+            if not has_knob and depth != 1:
+                out[cell_name] = None  # pre harness: lockstep only
+                continue
+            kw = {"pipeline_depth": depth} if has_knob else {}
+            cfg = FuzzerConfig(
+                mock=True, use_device=True, device_batch=batch,
+                program_length=16, device_period=2, smash_mutations=4,
+                procs=E2E_DEVICE_PROCS, **kw)
+            with Fuzzer(target, cfg) as f:
+                rate, execs, ni, delta = _timed_loop(f, seconds, reg)
+            out[cell_name] = {
+                "execs_per_sec": round(rate, 1),
+                "new_inputs": ni,
+                "execs_per_new_input": round(execs / max(ni, 1), 1),
+                **_pipeline_overlap(delta),
             }
     return out
 
@@ -804,6 +888,13 @@ def main(argv=None):
         return res
 
     run_config("prefix_depth_sweep", _prefix_sweep)
+
+    def _pipeline_sweep():
+        res = bench_pipeline_depth_sweep(target)
+        res["unit"] = "per-(batch, depth) execs/sec + stall/overlap"
+        return res
+
+    run_config("pipeline_depth_sweep", _pipeline_sweep)
 
     run_config("hub_sync", lambda: {
         "host": round(bench_hub(), 1), "unit": "progs/sec"})

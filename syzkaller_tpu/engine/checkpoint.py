@@ -23,6 +23,15 @@ mid-write leaves the previous checkpoint intact; reads verify magic,
 version, length, and CRC *before* unpickling, so one flipped byte yields
 a clean ``CheckpointError`` — the engine logs it, counts it, and starts
 fresh instead of crashing or loading garbage.
+
+The payload schema is the writer's (Fuzzer.checkpoint_state /
+_DevicePipeline.checkpoint_state) and evolves additively under ONE wire
+version: new optional keys, old keys kept readable.  Worked example:
+staged device work started as a single ``"pending"``/``"pending_ages"``
+batch (the PR 5 double buffer) and is now the ``"inflight"`` list of up
+to ``pipeline_depth`` slots ``{"outs": [8 arrays], "ages": ...}`` —
+restore accepts either, so pre-pipeline checkpoints resume as a one-slot
+ring and bit-identical resume stays pinned across the format change.
 """
 
 from __future__ import annotations

@@ -35,6 +35,7 @@ from ..telemetry import (
     get_ledger,
     get_registry,
     ops_from_mask,
+    record_span,
     span,
     timed,
 )
@@ -85,6 +86,13 @@ class FuzzerConfig:
     log_programs: bool = False          # emit `executing program` records
     sandbox: str = "none"
     device_period: int = 16             # consume a device batch every N steps
+    # depth of the device launch ring: how many sharded steps may be
+    # in flight (launched, not yet consumed) at once.  1 restores the
+    # old lockstep double buffer; >=2 overlaps device compute + D2H
+    # transfer with the host executor drain (each launch is an async
+    # enqueue, each output starts copy_to_host_async immediately, and
+    # the drain consumes whichever batch's transfer completes first)
+    pipeline_depth: int = 2
     # device-resident corpus arena rows (ops/arena.py): encoded programs
     # stay on the chips; eviction beyond this prefers the lowest-yield
     # row (FIFO among ties — see ops/arena.CorpusArena)
@@ -232,7 +240,7 @@ class Fuzzer:
         # one counter per transport attempt; engine-level sync failures
         # land in errors_rpc_poll_total via count_error, not here, so one
         # logical failure is never counted twice)
-        self._pending_new_inputs: List[tuple] = []
+        self._pending_new_inputs: deque = deque()
         self._h_ckpt_write = reg.histogram(
             "checkpoint_write_seconds",
             help="wall time of one atomic engine checkpoint write")
@@ -798,7 +806,8 @@ class Fuzzer:
                 count_error("rpc_new_input_dropped", RuntimeError(
                     f"{dropped} oldest new_input report(s) dropped, "
                     f"backlog full"))
-                del self._pending_new_inputs[:dropped]
+                for _ in range(dropped):
+                    self._pending_new_inputs.popleft()
 
     @staticmethod
     def _call_signal(infos: List[CallInfo], call_index: int
@@ -1630,7 +1639,7 @@ class Fuzzer:
             except Exception as e:
                 count_error("rpc_new_input", e)
                 break  # still flaky: keep the rest for the next poll
-            self._pending_new_inputs.pop(0)
+            self._pending_new_inputs.popleft()
 
     # ---- checkpoint / resume (engine/checkpoint.py) ----
 
@@ -1992,12 +2001,34 @@ class _BisectRounds:
             self._cond.notify_all()
 
 
+class _InflightSlot:
+    """One launched-but-unconsumed device batch in the pipeline ring:
+    the step's 8 output arrays (device arrays mid-flight; host numpy
+    after a checkpoint restore), the arena age-stamp snapshot taken at
+    launch (yield-credit guard), and the launch clock (retroactive
+    device.step span endpoint)."""
+
+    __slots__ = ("outs", "ages", "t0")
+
+    def __init__(self, outs, ages, t0):
+        self.outs = outs
+        self.ages = ages
+        self.t0 = t0
+
+
 class _DevicePipeline:
     """Device-side candidate factory: keeps the encoded corpus RESIDENT on
     device (ops/arena.CorpusArena — append-once ring tensors, sampled with
     jnp.take inside the sharded step) and emits batches of device-mutated
-    candidates, double-buffered so the TPU mutates batch N+1 while the
-    executor fleet runs batch N (SURVEY §7 hard part #3).
+    candidates through a depth-k in-flight ring
+    (``FuzzerConfig.pipeline_depth``) so the TPU mutates batches N+1..N+k
+    while the executor fleet runs batch N (SURVEY §7 hard part #3).
+    Each launch is one asynchronous enqueue (jax dispatch never blocks),
+    every launched output starts its device-to-host transfer immediately
+    via ``copy_to_host_async``, and the drain consumes whichever
+    in-flight batch's transfer completed first — stage, dispatch, and
+    drain overlap instead of running lockstep.  Depth 1 restores the old
+    double buffer exactly.
 
     The sample/mutate/fingerprint/new-signal/admission step is the
     SHARDED mesh step (parallel/mesh.make_arena_fuzz_step) over every
@@ -2045,8 +2076,14 @@ class _DevicePipeline:
         self._k_probes = max(int(cfg.admission_probes), 1)
         self._bloom_decay = float(cfg.admission_bloom_decay)
         self._yield_decay = float(cfg.arena_yield_decay)
+        # the arena weight table can only carry a real (row-sharded)
+        # sharding when the capacity divides the fuzz axis; otherwise it
+        # stays replicated (still correct — just no partitioned cumsum)
+        self._arena_cap = max(int(cfg.arena_capacity), 1)
+        self._shard_weights = (self._arena_cap % self.n_fuzz == 0)
         self._step, self._shardings = pmesh.make_arena_fuzz_step(
-            self.mesh, self.dt, batch=self.B, k_probes=self._k_probes)
+            self.mesh, self.dt, batch=self.B, k_probes=self._k_probes,
+            shard_weights=self._shard_weights)
         # the sharded bitset mapping requires power-of-two total bits
         # (parallel/mesh._shard_index); round up like the host mirror does
         nbits = 1 << (cfg.mirror_bits - 1).bit_length()
@@ -2062,21 +2099,25 @@ class _DevicePipeline:
             jnp.zeros(self._bloom_words, jnp.uint32),
             self._shardings["bloom"])
         self._key = jax.random.PRNGKey(1)
-        self._pending = None  # in-flight device computation (double buffer)
-        # arena age stamps snapshotted when the in-flight batch was
-        # launched: the yield-credit guard must compare against the ages
-        # the rows had AT SAMPLE TIME — a consume-time read would return
-        # the stamp of whatever program has since overwritten the row,
-        # letting the misattributed credit pass the guard
-        self._pending_ages = None
+        # depth-k in-flight ring: each slot holds one launched-but-not-
+        # yet-consumed step's outputs plus the arena age stamps
+        # snapshotted the instant it launched — the yield-credit guard
+        # must compare against the ages the rows had AT SAMPLE TIME; a
+        # consume-time read would return the stamp of whatever program
+        # has since overwritten the row, letting the misattributed
+        # credit pass the guard — and the launch clock for the
+        # retroactive (overlapping) device.step trace span
+        self.depth = max(int(cfg.pipeline_depth), 1)
+        self._inflight: deque = deque()
         self._sig_words = nwords
         self.degraded = False  # ladder exhausted: host mutation path only
         self.target = target
         # device-resident encoded corpus: programs are encoded once on
         # add_corpus and stay on the chips; the launch path samples rows
         # on device, so there is no per-launch host re-stacking
-        self.arena = CorpusArena(max(int(cfg.arena_capacity), 1), self.fmt,
-                                 sharding=self._shardings["arena"])
+        self.arena = CorpusArena(self._arena_cap, self.fmt,
+                                 sharding=self._shardings["arena"],
+                                 weights_sharding=self._shardings["weights"])
 
         # device-health gauges (ISSUE 2): read-on-demand callbacks, so a
         # /metrics or sampler tick always sees live state.  Buffer bytes
@@ -2119,6 +2160,19 @@ class _DevicePipeline:
         self._c_bloom_resets = reg.counter(
             "admission_bloom_resets_total",
             help="recent-hash Bloom filter decay resets")
+        # depth-k ring accounting: in-flight occupancy is the pipeline's
+        # health signal (a persistently sub-depth gauge means launches
+        # can't keep ahead of the drain), stalls are the honest cost
+        # counter the bench sweep reports alongside execs/sec
+        self._g_inflight = reg.gauge(
+            "device_pipeline_inflight",
+            help="launched-but-unconsumed device batches in the depth-k "
+                 "in-flight ring (pipeline_depth)")
+        self._c_stalls = reg.counter(
+            "device_pipeline_stalls_total",
+            help="device-batch consumes that had to block on an "
+                 "incomplete device-to-host transfer (no in-flight slot "
+                 "was ready when the drain wanted one)")
 
         def _live_bytes():
             return sum(getattr(a, "nbytes", 0) for a in jax.live_arrays())
@@ -2164,11 +2218,13 @@ class _DevicePipeline:
                     self._step, self._shardings = \
                         pmesh.make_arena_fuzz_step(
                             self.mesh, self.dt, batch=self.B,
-                            k_probes=self._k_probes)
+                            k_probes=self._k_probes,
+                            shard_weights=self._shard_weights,
+                            fresh=True)
                 return self._launch_once()
             except Exception as e:
                 count_error("device_step", e)
-                self._heal_donated_buffers()
+                self._heal_inflight()
                 if rung == "try":
                     self._c_step_retries.inc()
                     self._jemit("device_degrade", rung="retry")
@@ -2233,6 +2289,95 @@ class _DevicePipeline:
         self._bloom = healed(self._bloom, self._bloom_words,
                              self._shardings["bloom"])
 
+    def _heal_inflight(self) -> None:
+        """After a step failure, heal EVERY piece of device state the
+        failure may have poisoned — not just the newest launch's donated
+        buffers.  With depth-k batches in flight, the failed step's
+        donated sig/bloom inputs were the OUTPUTS of an earlier launch,
+        and a mid-flight device failure can kill buffers belonging to
+        ANY staged slot; a slot whose outputs died must be dropped (its
+        eventual drain would just raise again) while healthy older slots
+        keep their staged candidates.  The pre-pipeline code healed only
+        self._sig_shard/self._bloom and assumed the single pending batch
+        was still live — at depth>1 that left poisoned slots to blow up
+        the consume path later."""
+        self._heal_donated_buffers()
+        kept: deque = deque()
+        dropped = 0
+        for slot in self._inflight:
+            dead = False
+            for x in slot.outs:
+                try:
+                    if bool(x.is_deleted()):
+                        dead = True
+                        break
+                except Exception:
+                    continue  # host array / no introspection: live
+            if dead:
+                dropped += 1
+            else:
+                kept.append(slot)
+        self._inflight = kept
+        if dropped:
+            self._jemit("device_inflight_dropped", slots=dropped)
+        self._g_inflight.set(len(self._inflight))
+
+    # read-only single-slot views of the ring, kept for tests/tools
+    # written against the old double buffer: the OLDEST staged batch is
+    # what "the pending batch" used to mean (next to be consumed)
+
+    @property
+    def _pending(self):
+        return self._inflight[0].outs if self._inflight else None
+
+    @property
+    def _pending_ages(self):
+        return self._inflight[0].ages if self._inflight else None
+
+    def _fill(self) -> None:
+        """Top the in-flight ring up to pipeline depth.  Each launch is
+        one asynchronous enqueue behind the degradation ladder, and
+        every output immediately starts its device-to-host transfer
+        (``copy_to_host_async`` per array) so the drain later finds the
+        bytes already on the host instead of paying the D2H latency
+        synchronously."""
+        while (not self.degraded and len(self.arena) > 0
+               and len(self._inflight) < self.depth):
+            t0 = time.perf_counter()
+            outs = self._launch()
+            if outs is None:
+                break
+            for x in outs:
+                try:
+                    x.copy_to_host_async()
+                except AttributeError:
+                    pass  # restored host array: already on the host
+            # snapshot the age stamps the instant the batch launches
+            # (same thread: no append can interleave) — these are the
+            # sample-time stamps its eventual yield credits must carry
+            self._inflight.append(
+                _InflightSlot(outs, self.arena.ages.copy(), t0))
+        self._g_inflight.set(len(self._inflight))
+
+    def _take_ready(self) -> "_InflightSlot":
+        """Pop the first in-flight slot whose transfers have all landed
+        (restored host arrays count as landed); when none is ready yet
+        the drain is about to block on an incomplete transfer — count
+        the stall and take the oldest so consume order stays FIFO under
+        pressure."""
+        for i, slot in enumerate(self._inflight):
+            ready = True
+            for x in slot.outs:
+                is_ready = getattr(x, "is_ready", None)
+                if is_ready is not None and not is_ready():
+                    ready = False
+                    break
+            if ready:
+                del self._inflight[i]
+                return slot
+        self._c_stalls.inc()
+        return self._inflight.popleft()
+
     def credit_row(self, row: int, amount: float,
                    stamp: int = -1) -> None:
         """Feed triage-confirmed yield (new-signal PCs, corpus adds)
@@ -2243,29 +2388,55 @@ class _DevicePipeline:
         self.arena.credit(row, amount, stamp=stamp)
 
     def candidates(self, corpus: List[Prog]) -> Optional["_DeviceBatch"]:
-        """Return the previously launched batch — raw exec streams with a
-        lazy per-row decoder — and launch the next one.
+        """Consume the first READY in-flight batch — raw exec streams
+        with a lazy per-row decoder — and refill the launch ring.
 
-        Stale rows (fresh mask false) and admission-rejected rows
-        (in-batch duplicates, recent-hash Bloom hits) are dropped here,
-        before the host pays for emission or an executor round-trip; the
-        fast host boundary (prog/execgen.py) then emits executor wire
-        bytes straight from the tensors (~20x the decode_prog walk), and
-        a Prog tree is only materialized for rows the engine actually
+        The ring is topped up to ``pipeline_depth`` before and after the
+        consume, so at steady state the device is always mutating k
+        batches ahead of the executor drain; on a cold start (ring
+        empty) the just-launched work is left in flight and None is
+        returned rather than stalling the host on it.  Stale rows
+        (fresh mask false) and admission-rejected rows (in-batch
+        duplicates, recent-hash Bloom hits) are dropped here, before the
+        host pays for emission or an executor round-trip; the fast host
+        boundary (prog/execgen.py) then emits executor wire bytes
+        straight from the tensors (~20x the decode_prog walk), and a
+        Prog tree is only materialized for rows the engine actually
         wants to triage."""
         import numpy as np
 
-        done, done_ages = self._pending, self._pending_ages
-        self._pending = self._launch()
-        # snapshot the age stamps the instant the new batch launches
-        # (same thread: no append can interleave) — these are the
-        # sample-time stamps its eventual yield credits must carry
-        self._pending_ages = (self.arena.ages.copy()
-                              if self._pending is not None else None)
-        if done is None:
+        was_empty = not self._inflight
+        self._fill()
+        if was_empty or not self._inflight:
+            # warm-up (or degraded/empty arena): the batches just
+            # launched stay in flight — consuming one now would block
+            # the host on it, exactly the lockstep the ring removes
             return None
+        slot = self._take_ready()
+        try:
+            # the one host sync per consume: materializing np arrays
+            # blocks until the slot's D2H transfer lands (already
+            # complete unless _take_ready counted a stall)
+            with span("device.fuzz_step.sync"):
+                arrs = [np.asarray(x) for x in slot.outs]
+        except Exception as e:
+            # transfer surfaced a device failure post-launch: count it,
+            # heal what died (dropping any other poisoned slots), and
+            # skip this consume — the campaign continues
+            count_error("device_step", e)
+            self._c_step_retries.inc()
+            self._jemit("device_degrade", rung="consume_retry")
+            self._heal_inflight()
+            self._fill()
+            return None
+        # the honest overlapping trace record: launch -> consume per
+        # slot, so at depth>=2 the device.step spans overlap and their
+        # sum can exceed the wall time of the drain loop
+        record_span("device.step", slot.t0, time.perf_counter())
+        self._fill()  # replace the consumed slot before the host drains
+        done_ages = slot.ages
         (idx, cid, sval, data, fresh, admit,
-         op_mask, bloom_pop) = (np.asarray(x) for x in done)
+         op_mask, bloom_pop) = arrs
         fresh = fresh.astype(bool)
         admit = admit.astype(bool)
         total = int(cid.shape[0])
@@ -2309,17 +2480,19 @@ class _DevicePipeline:
         the corpus arena (rows + ring cursor/size/evictions + yield
         scores/ages), the sharded proxy signal bitset, the admission
         Bloom filter, the device PRNG key, and — so resume never
-        re-mutates a batch of work — the in-flight double-buffered
-        candidate batch (staged rows, pre-compaction) with its
-        launch-time age-stamp snapshot."""
+        re-mutates batches of work — ALL k in-flight candidate batches
+        (staged rows, pre-compaction, oldest first) each with its
+        launch-time age-stamp snapshot.  Pulling a mid-flight batch to
+        the host here forces its transfer; that is the price of an
+        exact checkpoint, paid only on the checkpoint cadence."""
         import numpy as np
 
         jax = self._jax
         a_cid, a_sval, a_data = self.arena.tensors()
-        pending = None
-        if self._pending is not None:
-            pending = [np.asarray(jax.device_get(x))
-                       for x in self._pending]
+        inflight = [{
+            "outs": [np.asarray(jax.device_get(x)) for x in slot.outs],
+            "ages": (slot.ages.copy() if slot.ages is not None else None),
+        } for slot in self._inflight]
         return {
             "arena": {
                 "cid": np.asarray(jax.device_get(a_cid)),
@@ -2336,9 +2509,7 @@ class _DevicePipeline:
             "sig_shard": np.asarray(jax.device_get(self._sig_shard)),
             "bloom": np.asarray(jax.device_get(self._bloom)),
             "key": np.asarray(jax.device_get(self._key)),
-            "pending": pending,
-            "pending_ages": (self._pending_ages.copy()
-                             if self._pending_ages is not None else None),
+            "inflight": inflight,
         }
 
     def validate_state(self, st: dict) -> None:
@@ -2372,6 +2543,13 @@ class _DevicePipeline:
             raise ValueError(
                 f"checkpoint pending batch has {len(pending)} fields, "
                 f"expected 8")
+        for i, slot in enumerate(st.get("inflight") or ()):
+            outs = slot.get("outs")
+            if outs is None or len(outs) != 8:
+                raise ValueError(
+                    f"checkpoint inflight slot {i} has "
+                    f"{0 if outs is None else len(outs)} fields, "
+                    f"expected 8")
 
     def restore_state(self, st: dict) -> None:
         import numpy as np
@@ -2401,18 +2579,31 @@ class _DevicePipeline:
         # (older checkpoints carry a "pick" host-RNG state from when row
         # selection happened host-side; selection is on-device now, so
         # the key is simply ignored)
-        # the in-flight double-buffered batch: restoring it means resume
-        # continues with the EXACT candidates that were staged when the
-        # checkpoint was written, instead of re-mutating one batch of
-        # work (host numpy is fine here — candidates() materializes with
-        # np.asarray either way), plus its launch-time age stamps so
+        # the in-flight batches: restoring them (oldest first) means
+        # resume continues with the EXACT candidates that were staged
+        # when the checkpoint was written, instead of re-mutating up to
+        # k batches of work (host numpy is fine here — candidates()
+        # materializes with np.asarray either way, and host arrays
+        # always test ready so restored slots drain deterministically in
+        # checkpoint order), each with its launch-time age stamps so
         # yield credits stay guarded across the restart
+        def _host_slot(outs, ages):
+            return _InflightSlot(
+                tuple(np.asarray(x) for x in outs),
+                (np.asarray(ages, np.int64).copy()
+                 if ages is not None else None),
+                time.perf_counter())
+
+        self._inflight = deque()
+        for slot in st.get("inflight") or ():
+            self._inflight.append(
+                _host_slot(slot["outs"], slot.get("ages")))
+        # pre-pipeline checkpoints staged at most one batch ("pending")
         pending = st.get("pending")
-        self._pending = (tuple(np.asarray(x) for x in pending)
-                         if pending is not None else None)
-        ages = st.get("pending_ages")
-        self._pending_ages = (np.asarray(ages, np.int64).copy()
-                              if ages is not None else None)
+        if not self._inflight and pending is not None:
+            self._inflight.append(
+                _host_slot(pending, st.get("pending_ages")))
+        self._g_inflight.set(len(self._inflight))
 
 
 class _DeviceBatch:

@@ -95,7 +95,7 @@ class CorpusArena:
     """
 
     def __init__(self, capacity: int, fmt, sharding=None,
-                 registry=None):
+                 weights_sharding=None, registry=None):
         cap = int(capacity)
         if cap <= 0:
             raise ValueError(f"arena capacity must be positive, got {cap}")
@@ -114,9 +114,18 @@ class CorpusArena:
         sval = jnp.zeros((cap, fmt.max_calls, fmt.max_slots), jnp.uint64)
         data = jnp.zeros((cap, fmt.max_calls, fmt.arena), jnp.uint8)
         w = jnp.zeros((cap,), jnp.uint32)
+        # shard-aware placement: the row tensors and the weight table may
+        # carry DIFFERENT shardings — under the explicit-sharding step
+        # the [cap] u32 weight table shards over the ``fuzz`` axis while
+        # the gathered row tensors stay replicated (parallel/mesh.
+        # make_arena_fuzz_step's shardings dict is the source of truth)
+        self._w_sharding = (weights_sharding if weights_sharding
+                            is not None else sharding)
         if sharding is not None:
-            cid, sval, data, w = (jax.device_put(x, sharding)
-                                  for x in (cid, sval, data, w))
+            cid, sval, data = (jax.device_put(x, sharding)
+                               for x in (cid, sval, data))
+        if self._w_sharding is not None:
+            w = jax.device_put(w, self._w_sharding)
         self.cid, self.sval, self.data = cid, sval, data
         self.weights = w
         self._sharding = sharding
@@ -266,8 +275,8 @@ class CorpusArena:
                 return
             self.yields *= factor
             w = jnp.asarray(project_weights(self.yields, self.size))
-            if self._sharding is not None:
-                w = jax.device_put(w, self._sharding)
+            if self._w_sharding is not None:
+                w = jax.device_put(w, self._w_sharding)
             self.weights = w
             self._c_yield_decays.inc()
 
@@ -303,8 +312,10 @@ class CorpusArena:
         size = min(max(int(size), 0), self.capacity)
         w = jnp.asarray(project_weights(new_yields, size))
         if self._sharding is not None:
-            cid, sval, data, w = (jax.device_put(x, self._sharding)
-                                  for x in (cid, sval, data, w))
+            cid, sval, data = (jax.device_put(x, self._sharding)
+                               for x in (cid, sval, data))
+        if self._w_sharding is not None:
+            w = jax.device_put(w, self._w_sharding)
         with self._lock:
             self.cid, self.sval, self.data = cid, sval, data
             self.weights = w

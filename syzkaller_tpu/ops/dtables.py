@@ -85,10 +85,34 @@ jax.tree_util.register_dataclass(
 )
 
 
+# memo for the default build (no prio/enabled override): every Fuzzer
+# construction over the same target asks for the same tables, and the
+# per-slot Python loop plus host->device uploads cost real time.
+# Returning the *same* DeviceTables object also lets downstream
+# identity-keyed caches (parallel/mesh._ARENA_STEP_CACHE) hit.  ct is
+# pinned in the value so a recycled id can never alias a dead table set.
+_DT_CACHE: dict = {}
+
+
 def build_device_tables(ct: CompiledTables, fmt: TensorFormat,
                         prios: Optional[np.ndarray] = None,
                         enabled_mask: Optional[np.ndarray] = None
                         ) -> DeviceTables:
+    if prios is None and enabled_mask is None:
+        key = (id(ct), fmt.max_calls, fmt.max_slots, fmt.arena)
+        hit = _DT_CACHE.get(key)
+        if hit is not None and hit[0] is ct:
+            return hit[1]
+        dt = _build_device_tables(ct, fmt, None, None)
+        _DT_CACHE[key] = (ct, dt)
+        return dt
+    return _build_device_tables(ct, fmt, prios, enabled_mask)
+
+
+def _build_device_tables(ct: CompiledTables, fmt: TensorFormat,
+                         prios: Optional[np.ndarray] = None,
+                         enabled_mask: Optional[np.ndarray] = None
+                         ) -> DeviceTables:
     n, S, D = ct.n_calls, fmt.max_slots, fmt.arena
     R = max(ct.n_res_kinds, 1)
 

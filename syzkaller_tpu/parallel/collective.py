@@ -10,6 +10,17 @@ merging between chips rides ICI as XLA collectives:
   - "any chip saw new signal" = boolean psum,
   - corpus/candidate exchange = all_gather of program tensors
     (the hub-sync analogue; across pods the same op rides DCN).
+
+Two programming models consume these:
+
+  - shard_map bodies call the named collectives below directly
+    (``jax.lax.axis_index`` / ``psum`` / ``all_gather``);
+  - the explicit-sharding (global-view) steps in ``parallel/mesh.py``
+    express the same unions as plain array ops and let the SPMD
+    partitioner insert the collectives — the only per-shard identity
+    they still need is the deterministic per-shard PRNG fold, which
+    ``per_shard_keys`` provides as the global-view analogue of
+    ``fold_in(key, axis_index(...))``.
 """
 
 from __future__ import annotations
@@ -18,6 +29,17 @@ from . import ensure_x64  # noqa: F401
 
 import jax
 import jax.numpy as jnp
+
+
+def per_shard_keys(key, n_shards: int):
+    """[n_shards, ...] PRNG keys: ``fold_in(key, i)`` for each shard
+    index, bit-identical to what a shard_map body computes from
+    ``fold_in(key, axis_index(axis))`` on shard i.  This is how the
+    global-view (explicit-sharding) steps keep per-shard mutation
+    streams identical to the shard_map implementation — the parity
+    suite in tests/test_parallel.py pins it."""
+    idx = jnp.arange(n_shards, dtype=jnp.uint32)
+    return jax.vmap(lambda i: jax.random.fold_in(key, i))(idx)
 
 
 def or_all_reduce(x, axis_name: str):

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import collective as pcol
 from ..ops.dtables import DeviceTables
 from ..ops import admission as dadm
 from ..ops import cover as dcov
@@ -253,6 +254,60 @@ def fold_admission(bloom_shard, probes):
 
 
 # ---------------------------------------------------------------------- #
+# global-view (explicit-sharding) bitset folds
+#
+# Same semantics as fold_signals / fold_admission, written over the FULL
+# bitset instead of a per-device shard: the jitted step carries explicit
+# NamedShardings (in_shardings/out_shardings), so the SPMD partitioner
+# inserts the gather/scatter collectives that the shard_map bodies spell
+# out by hand.  Bit-identity between the two implementations is pinned by
+# the parity suite in tests/test_parallel.py.
+
+
+def _global_index(nwords: int, sigs):
+    """The canonical bitset mapping (ops/cover._index) over the full
+    [nwords] table, plus the validity mask the shard path derives from
+    per-shard ownership: across all shards, a lane is "owned somewhere"
+    iff its signal is not SENT.  Power-of-two total bits required, like
+    ``_shard_index``."""
+    nbits = nwords * 32
+    assert nbits & (nbits - 1) == 0, \
+        f"bitset must be power-of-two total bits, got {nbits}"
+    h = jnp.asarray(sigs, U32)
+    masked = h & U32(nbits - 1)
+    return masked >> 5, masked & U32(31), (h != SENT)
+
+
+def fold_signals_global(sig, sigs, gate=None):
+    """Global-view fold_signals: union executed signals ([b, K] u32
+    padded SENT) into the full bitset; return (new sig, fresh[b]).
+    ``gate`` restricts the FOLD while the freshness TEST still covers
+    every row (see fold_signals)."""
+    word, bit, valid = _global_index(sig.shape[0], sigs)
+    hit = dcov.bitset_test_words(sig, word, bit)
+    fresh = jnp.any(valid & ~hit, axis=-1)
+    if gate is not None:
+        sigs = jnp.where(gate[..., None], jnp.asarray(sigs, U32), SENT)
+    word, bit, valid = _global_index(sig.shape[0],
+                                     jnp.asarray(sigs, U32).reshape(-1))
+    sig = dcov.bitset_or_words(sig, word, bit, valid)
+    return sig, fresh
+
+
+def fold_admission_global(bloom, probes):
+    """Global-view fold_admission: Bloom membership + update over the
+    full recent-hash bitset.  Returns (new bloom, seen[b] = ALL K probe
+    bits already set).  Every row's probes are folded in — a rejected
+    duplicate must stay remembered."""
+    word, bit, valid = _global_index(bloom.shape[0], probes)
+    hit = dcov.bitset_test_words(bloom, word, bit)
+    seen = ~jnp.any(valid & ~hit, axis=-1)
+    word, bit, valid = _global_index(bloom.shape[0], probes.reshape(-1))
+    bloom = dcov.bitset_or_words(bloom, word, bit, valid)
+    return bloom, seen
+
+
+# ---------------------------------------------------------------------- #
 # the sharded fuzz step
 
 
@@ -277,8 +332,36 @@ def _step_body(dt: DeviceTables, rounds: int, key, cid, sval, data,
     return cid, sval, data, sig_shard, fresh, op_mask
 
 
+def _step_body_explicit(dt: DeviceTables, rounds: int, n_fuzz: int, key,
+                        cid, sval, data, sig):
+    """Global-view body of the fuzz step for the explicit-sharding
+    (pjit) compile path: the SAME per-shard computation as
+    ``_step_body``, written over the full batch/bitset — per-shard PRNG
+    streams come from ``collective.per_shard_keys`` (bit-identical to
+    ``fold_in(key, axis_index)``) and each shard's rows are mutated
+    under a vmap over the shard axis, so the lane-level arithmetic is
+    identical to the shard_map implementation (parity-pinned)."""
+    B = cid.shape[0]
+    assert B % n_fuzz == 0, (B, n_fuzz)
+    b = B // n_fuzz
+    keys = pcol.per_shard_keys(key, n_fuzz)
+
+    def mut(k, c, s, d):
+        return dmut.mutate_rows_stratified_traced(k, dt, c, s, d, rounds)
+
+    cid, sval, data, op_mask = (
+        x.reshape((B,) + x.shape[2:]) for x in jax.vmap(mut)(
+            keys,
+            cid.reshape((n_fuzz, b) + cid.shape[1:]),
+            sval.reshape((n_fuzz, b) + sval.shape[1:]),
+            data.reshape((n_fuzz, b) + data.shape[1:])))
+    sigs = jax.vmap(call_fingerprints)(cid, sval)      # [B, C] u32
+    sig, fresh = fold_signals_global(sig, sigs)
+    return cid, sval, data, sig, fresh, op_mask
+
+
 def make_fuzz_step(mesh: Mesh, dt: DeviceTables, *, rounds: int = 2,
-                   donate: bool = True):
+                   donate: bool = True, impl: str = "explicit"):
     """Compile the full sharded fuzz step over `mesh`.
 
     Returns (step, sharding) where
@@ -290,26 +373,53 @@ def make_fuzz_step(mesh: Mesh, dt: DeviceTables, *, rounds: int = 2,
     [B] u32 carries per-lane mutation-operator provenance (bit i set iff
     operator i touched the lane) for the attribution ledger.
 
+    ``impl`` selects the compile path:
+      - ``"explicit"`` (default, the production path): a global-view
+        body jitted with explicit NamedSharding in_shardings /
+        out_shardings and per-argument donation — the SPMD partitioner
+        inserts the collectives, the shardings survive a >1-host mesh,
+        and dispatch is fully async (the depth-k pipeline rides this).
+      - ``"shard_map"``: the per-device body under the version-tolerant
+        shard_map wrapper (kept as the parity reference — both paths
+        are pinned bit-identical in tests/test_parallel.py).
+
     With ``donate`` (the default) the batch tensors and the signal bitset
-    are donated, so the double-buffered engine loop updates its shards in
+    are donated, so the pipelined engine loop updates its shards in
     place instead of allocating fresh [B, ...] + bitset buffers every
     round — the inputs are INVALID after the call; pass ``donate=False``
     when the caller must reuse them (parity tests)."""
     pspec_batch = P(AXIS_FUZZ)
     pspec_sig = P(AXIS_COVER)
+    batch_s = NamedSharding(mesh, pspec_batch)
+    sig_s = NamedSharding(mesh, pspec_sig)
+    repl_s = NamedSharding(mesh, P())
 
-    body = partial(_step_body, dt, rounds)
-    mapped = shard_map(
-        body, mesh=mesh,
-        in_specs=(P(), pspec_batch, pspec_batch, pspec_batch, pspec_sig),
-        out_specs=(pspec_batch, pspec_batch, pspec_batch, pspec_sig,
-                   pspec_batch, pspec_batch))
-    jitted = jax.jit(mapped, donate_argnums=(1, 2, 3, 4) if donate else ())
+    if impl == "explicit":
+        n_fuzz = mesh.devices.shape[0]
+        body = partial(_step_body_explicit, dt, rounds, n_fuzz)
+        jitted = jax.jit(
+            body,
+            in_shardings=(repl_s, batch_s, batch_s, batch_s, sig_s),
+            out_shardings=(batch_s, batch_s, batch_s, sig_s, batch_s,
+                           batch_s),
+            donate_argnums=(1, 2, 3, 4) if donate else ())
+    elif impl == "shard_map":
+        body = partial(_step_body, dt, rounds)
+        mapped = shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), pspec_batch, pspec_batch, pspec_batch,
+                      pspec_sig),
+            out_specs=(pspec_batch, pspec_batch, pspec_batch, pspec_sig,
+                       pspec_batch, pspec_batch))
+        jitted = jax.jit(mapped,
+                         donate_argnums=(1, 2, 3, 4) if donate else ())
+    else:
+        raise ValueError(f"unknown fuzz-step impl {impl!r}")
     step = _timed_step(jitted, "device.fuzz_step")
     shardings = {
-        "batch": NamedSharding(mesh, pspec_batch),
-        "signal": NamedSharding(mesh, pspec_sig),
-        "replicated": NamedSharding(mesh, P()),
+        "batch": batch_s,
+        "signal": sig_s,
+        "replicated": repl_s,
     }
     return step, shardings
 
@@ -364,10 +474,90 @@ def _arena_step_body(dt: DeviceTables, rounds: int, b_local: int,
             op_mask, pop)
 
 
+def _arena_step_body_explicit(dt: DeviceTables, rounds: int, n_fuzz: int,
+                              b_local: int, k_probes: int, key, a_cid,
+                              a_sval, a_data, weights, sig, bloom):
+    """Global-view body of the arena launch path for the
+    explicit-sharding compile path: the SAME computation as
+    ``_arena_step_body`` over the full batch — per-shard key folds via
+    ``collective.per_shard_keys``, the weighted draw and mutation
+    vmapped over the shard axis so every lane's PRNG stream and
+    arithmetic match the shard_map implementation bit-for-bit
+    (parity-pinned), and the bitset/Bloom folds over the full tables
+    (the partitioner turns them into the gather/scatter collectives the
+    shard_map body spells out by hand)."""
+    keys = pcol.per_shard_keys(key, n_fuzz)
+    ks = jax.vmap(jax.random.split)(keys)
+    kidx, kmut = ks[:, 0], ks[:, 1]
+    B = n_fuzz * b_local
+    # yield-weighted sampling: one global cumsum, per-shard draw words
+    cw = jnp.cumsum(weights.astype(jnp.uint64))
+    words = jax.vmap(
+        lambda k: jax.random.bits(k, (b_local,), dtype=jnp.uint64))(kidx)
+    idx = jnp.minimum(
+        drng.choose_weighted_from(words.reshape(-1), cw),
+        weights.shape[0] - 1)
+    cid = jnp.take(a_cid, idx, axis=0)
+    sval = jnp.take(a_sval, idx, axis=0)
+    data = jnp.take(a_data, idx, axis=0)
+
+    def mut(k, c, s, d):
+        return dmut.mutate_rows_stratified_traced(k, dt, c, s, d, rounds)
+
+    cid, sval, data, op_mask = (
+        x.reshape((B,) + x.shape[2:]) for x in jax.vmap(mut)(
+            kmut,
+            cid.reshape((n_fuzz, b_local) + cid.shape[1:]),
+            sval.reshape((n_fuzz, b_local) + sval.shape[1:]),
+            data.reshape((n_fuzz, b_local) + data.shape[1:])))
+    # --- admission FIRST: hash, in-batch dedup, Bloom test+fold ---
+    h = jax.vmap(dadm.row_hash)(cid, sval, data)       # [B] u64
+    first = dadm.inbatch_first_mask(h)
+    bloom, seen = fold_admission_global(
+        bloom, dadm.bloom_probes(h, k_probes))
+    admit = first & ~seen
+    pop = jnp.sum(jax.lax.population_count(bloom))
+    sigs = jax.vmap(call_fingerprints)(cid, sval)      # [B, C] u32
+    sig, fresh = fold_signals_global(sig, sigs, gate=admit)
+    return (idx, cid, sval, data, sig, bloom, fresh, admit, op_mask, pop)
+
+
+# compiled-step memo: every Fuzzer construction in a process asks for
+# the same (mesh, tables, batch) step, and tracing + XLA-compiling the
+# global-view body costs seconds each time.  Keyed on dt *identity*
+# (build_device_tables memoizes, so equal inputs yield the same object)
+# with dt pinned in the value so a recycled id can never alias a dead
+# table set.  ``fresh=True`` bypasses AND refreshes the entry — the
+# degradation ladder's recompile rung wants a genuinely new executable.
+_ARENA_STEP_CACHE: dict = {}
+
+
 def make_arena_fuzz_step(mesh: Mesh, dt: DeviceTables, *, batch: int,
                          rounds: int = 2,
                          k_probes: int = dadm.BLOOM_PROBES,
-                         donate: bool = True):
+                         donate: bool = True, impl: str = "explicit",
+                         shard_weights: bool = False,
+                         fresh: bool = False):
+    """Memoized front door for ``_build_arena_fuzz_step`` (the API
+    contract lives on its docstring); ``fresh=True`` forces a rebuild."""
+    key = (mesh, id(dt), batch, rounds, k_probes, donate, impl,
+           shard_weights)
+    if not fresh:
+        hit = _ARENA_STEP_CACHE.get(key)
+        if hit is not None and hit[0] is dt:
+            return hit[1], hit[2]
+    step, shardings = _build_arena_fuzz_step(
+        mesh, dt, batch=batch, rounds=rounds, k_probes=k_probes,
+        donate=donate, impl=impl, shard_weights=shard_weights)
+    _ARENA_STEP_CACHE[key] = (dt, step, shardings)
+    return step, shardings
+
+
+def _build_arena_fuzz_step(mesh: Mesh, dt: DeviceTables, *, batch: int,
+                           rounds: int = 2,
+                           k_probes: int = dadm.BLOOM_PROBES,
+                           donate: bool = True, impl: str = "explicit",
+                           shard_weights: bool = False):
     """Compile the arena-sampling sharded fuzz step over `mesh`.
 
     Returns (step, sharding) where
@@ -375,38 +565,70 @@ def make_arena_fuzz_step(mesh: Mesh, dt: DeviceTables, *, batch: int,
            bloom)
         -> (idx, cid, sval, data, sig_shard, bloom, fresh, admit,
             op_mask, bloom_popcount)
-    The arena tensors ([cap, ...], ops/arena.CorpusArena) and the [cap]
-    u32 weight vector are replicated and sampled on device inside the
-    jitted step — the only per-launch host->device transfer is the
-    replicated PRNG key.  ``idx`` [B] i32 reports which arena row each
-    candidate was drawn from (provenance -> yield credit); ``admit``
-    [B] bool is the device-side admission verdict (in-batch-unique AND
-    not recently seen); ``bloom_popcount`` is the set-bit count of the
-    updated filter (drives the decay/reset policy without an extra
-    device round-trip).  ``batch`` must divide the fuzz axis.  The
-    signal bitset and the Bloom filter are donated (``donate``) so the
-    steady-state loop reuses the buffers; the arena tensors and weights
-    are NOT donated — they persist across launches by design."""
+    The arena tensors ([cap, ...], ops/arena.CorpusArena) are sampled on
+    device inside the jitted step — the only per-launch host->device
+    transfer is the replicated PRNG key.  ``idx`` [B] i32 reports which
+    arena row each candidate was drawn from (provenance -> yield
+    credit); ``admit`` [B] bool is the device-side admission verdict
+    (in-batch-unique AND not recently seen); ``bloom_popcount`` is the
+    set-bit count of the updated filter (drives the decay/reset policy
+    without an extra device round-trip).  ``batch`` must divide the
+    fuzz axis.  The signal bitset and the Bloom filter are donated
+    (``donate``) so the steady-state loop reuses the buffers; the arena
+    tensors and weights are NOT donated — they persist across launches
+    by design.
+
+    ``impl`` selects the compile path (see ``make_fuzz_step``):
+    ``"explicit"`` (default) jits a global-view body with explicit
+    NamedSharding in_shardings/out_shardings + per-argument donation so
+    the 64-Mbit signal bitset, the Bloom filter, and — with
+    ``shard_weights`` (requires capacity % fuzz-axis == 0) — the arena
+    weight table carry real shardings that survive a >1-host mesh;
+    ``"shard_map"`` keeps the per-device body under the
+    version-tolerant wrapper as the bit-identical parity reference."""
     pspec_batch = P(AXIS_FUZZ)
     pspec_sig = P(AXIS_COVER)
     n_fuzz = mesh.devices.shape[0]
     assert batch % n_fuzz == 0, (batch, n_fuzz)
+    batch_s = NamedSharding(mesh, pspec_batch)
+    sig_s = NamedSharding(mesh, pspec_sig)
+    repl_s = NamedSharding(mesh, P())
+    # the [cap] u32 weight table can shard over ``fuzz`` (the global
+    # cumsum is one small collective); the row tensors stay replicated —
+    # the weighted gather needs arbitrary rows on every shard
+    weights_s = batch_s if (impl == "explicit" and shard_weights) \
+        else repl_s
 
-    body = partial(_arena_step_body, dt, rounds, batch // n_fuzz, k_probes)
-    mapped = shard_map(
-        body, mesh=mesh,
-        in_specs=(P(), P(), P(), P(), P(), pspec_sig, pspec_sig),
-        out_specs=(pspec_batch, pspec_batch, pspec_batch, pspec_batch,
-                   pspec_sig, pspec_sig, pspec_batch, pspec_batch,
-                   pspec_batch, P()))
-    jitted = jax.jit(mapped, donate_argnums=(5, 6) if donate else ())
+    if impl == "explicit":
+        body = partial(_arena_step_body_explicit, dt, rounds, n_fuzz,
+                       batch // n_fuzz, k_probes)
+        jitted = jax.jit(
+            body,
+            in_shardings=(repl_s, repl_s, repl_s, repl_s, weights_s,
+                          sig_s, sig_s),
+            out_shardings=(batch_s, batch_s, batch_s, batch_s, sig_s,
+                           sig_s, batch_s, batch_s, batch_s, repl_s),
+            donate_argnums=(5, 6) if donate else ())
+    elif impl == "shard_map":
+        body = partial(_arena_step_body, dt, rounds, batch // n_fuzz,
+                       k_probes)
+        mapped = shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), P(), P(), P(), P(), pspec_sig, pspec_sig),
+            out_specs=(pspec_batch, pspec_batch, pspec_batch, pspec_batch,
+                       pspec_sig, pspec_sig, pspec_batch, pspec_batch,
+                       pspec_batch, P()))
+        jitted = jax.jit(mapped, donate_argnums=(5, 6) if donate else ())
+    else:
+        raise ValueError(f"unknown arena-fuzz-step impl {impl!r}")
     step = _timed_step(jitted, "device.fuzz_step")
     shardings = {
-        "batch": NamedSharding(mesh, pspec_batch),
-        "signal": NamedSharding(mesh, pspec_sig),
-        "bloom": NamedSharding(mesh, pspec_sig),
-        "replicated": NamedSharding(mesh, P()),
-        "arena": NamedSharding(mesh, P()),
+        "batch": batch_s,
+        "signal": sig_s,
+        "bloom": sig_s,
+        "replicated": repl_s,
+        "arena": repl_s,
+        "weights": weights_s,
     }
     return step, shardings
 

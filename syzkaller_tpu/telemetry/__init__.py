@@ -47,7 +47,7 @@ from .timeseries import (  # noqa: F401
     TimeSeriesStore,
     rate_points,
 )
-from .trace import Tracer, get_tracer, span, timed  # noqa: F401
+from .trace import Tracer, get_tracer, record_span, span, timed  # noqa: F401
 
 
 def telemetry_dump() -> dict:

@@ -123,6 +123,19 @@ class Tracer:
             return _NULL_SPAN
         return _Span(self, name)
 
+    def record_span(self, name: str, t0: float, t1: float) -> None:
+        """Record an already-measured interval (``time.perf_counter``
+        endpoints) as a finished span.  This is how OVERLAPPING device
+        work gets honest trace events: the pipelined engine stamps t0 at
+        launch and t1 when the batch's transfer is consumed, so with k
+        batches in flight the ``device.step`` spans overlap each other
+        (their sum can exceed wall time — the point of the pipeline).
+        A live ``span()`` context can't express that: it nests on one
+        thread's stack."""
+        if not self.enabled:
+            return
+        self._record(name, t0, t1, 0)
+
     def timed(self, name: str, hist: _metrics.Histogram):
         """One timing, two sinks: the explicit histogram always gets the
         observation (latency metrics are wire stats), and a trace event
@@ -207,3 +220,9 @@ def timed(name: str, hist: _metrics.Histogram):
     timing feeding the explicit histogram (always) and the trace buffer
     (when spans are enabled)."""
     return _default.timed(name, hist)
+
+
+def record_span(name: str, t0: float, t1: float) -> None:
+    """Retroactively record a measured interval on the default tracer
+    (see Tracer.record_span — overlapping in-flight device work)."""
+    _default.record_span(name, t0, t1)

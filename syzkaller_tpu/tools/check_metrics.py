@@ -106,6 +106,11 @@ REQUIRED_METRICS = (
     "frontend_miscompares_total",
     "frontend_exceptions_total",
     "frontend_exec_timeouts_total",
+    # async pipelined device step (ISSUE 18): ring occupancy is the
+    # pipeline's health signal and stalls are its honest cost — the
+    # depth sweep in bench.py reads both next to execs/sec
+    "device_pipeline_inflight",
+    "device_pipeline_stalls_total",
 )
 
 

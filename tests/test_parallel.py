@@ -213,6 +213,107 @@ def test_arena_fuzz_step_weighted_draw_concentrates(env):
     np.testing.assert_array_equal(np.asarray(idx), np.full(B, 3))
 
 
+def _arena_inputs(env, key, cap=8, B=16, weights=None):
+    """Replicated arena row tensors + weight table for an arena step."""
+    target, tables, fmt, dt, m = env
+    gen = pmesh.make_generate_step(m, dt, C=fmt.max_calls)
+    a_cid, a_sval, a_data = gen(key, jnp.zeros((cap,), jnp.int32))
+    repl = jax.sharding.NamedSharding(m, jax.sharding.PartitionSpec())
+    a_cid, a_sval, a_data = (
+        jax.device_put(x, repl) for x in (a_cid, a_sval, a_data))
+    if weights is None:
+        weights = (jnp.arange(cap, dtype=jnp.uint32) % 3) + 1
+    weights = jax.device_put(jnp.asarray(weights, jnp.uint32), repl)
+    return a_cid, a_sval, a_data, weights
+
+
+ARENA_OUT_NAMES = ("idx", "cid", "sval", "data", "sig", "bloom", "fresh",
+                   "admit", "op_mask", "pop")
+
+
+def test_arena_step_explicit_parity_with_shard_map(env):
+    """PINNED: the explicit-sharding (global-view jit) arena step is
+    BIT-IDENTICAL to the shard_map step on the 4x2 mesh — per-shard PRNG
+    streams (collective.per_shard_keys vs fold_in(key, axis_index)),
+    the yield-weighted draw, mutation, the in-batch+Bloom admission
+    gate, the gated signal fold, and operator provenance all match, and
+    across two CHAINED launches so the carried sig/bloom state is
+    covered too.  This is the contract that let the engine switch to
+    explicit shardings without a behavior change."""
+    target, tables, fmt, dt, m = env
+    B = 16
+    a_cid, a_sval, a_data, weights = _arena_inputs(
+        env, jax.random.PRNGKey(23))
+    outs = {}
+    for impl in ("explicit", "shard_map"):
+        step, shardings = pmesh.make_arena_fuzz_step(
+            m, dt, batch=B, donate=False, impl=impl)
+        sig = jax.device_put(jnp.zeros(NBITS // 32, jnp.uint32),
+                             shardings["signal"])
+        bloom = jax.device_put(jnp.zeros(NBITS // 32, jnp.uint32),
+                               shardings["bloom"])
+        first = step(jax.random.PRNGKey(31), a_cid, a_sval, a_data,
+                     weights, sig, bloom)
+        second = step(jax.random.PRNGKey(37), a_cid, a_sval, a_data,
+                      weights, first[4], first[5])
+        outs[impl] = [np.asarray(x) for x in (*first, *second)]
+    for i, (a, b) in enumerate(zip(outs["explicit"], outs["shard_map"])):
+        np.testing.assert_array_equal(
+            a, b, err_msg=f"launch {i // 10} output "
+                          f"{ARENA_OUT_NAMES[i % 10]} diverged")
+
+
+def test_fuzz_step_explicit_parity_with_shard_map(env):
+    """Same pin for the non-arena fuzz step: mutate-in-place + signal
+    fold, all six outputs bit-identical across the two impls."""
+    target, tables, fmt, dt, m = env
+    B = 16
+    gen = pmesh.make_generate_step(m, dt, C=fmt.max_calls)
+    cid, sval, data = gen(jax.random.PRNGKey(41),
+                          jnp.zeros((B,), jnp.int32))
+    cid, sval, data = (np.asarray(x).copy() for x in (cid, sval, data))
+    outs = {}
+    for impl in ("explicit", "shard_map"):
+        step, shardings = pmesh.make_fuzz_step(
+            m, dt, donate=False, impl=impl)
+        batch = tuple(jax.device_put(jnp.asarray(x), shardings["batch"])
+                      for x in (cid, sval, data))
+        sig = jax.device_put(jnp.zeros(NBITS // 32, jnp.uint32),
+                             shardings["signal"])
+        out = step(jax.random.PRNGKey(43), *batch, sig)
+        outs[impl] = [np.asarray(x) for x in out]
+    names = ("cid", "sval", "data", "sig", "fresh", "op_mask")
+    for name, a, b in zip(names, outs["explicit"], outs["shard_map"]):
+        np.testing.assert_array_equal(
+            a, b, err_msg=f"fuzz-step output {name} diverged")
+
+
+def test_arena_step_sharded_weights_parity(env):
+    """shard_weights=True (the real row-sharded weight table the engine
+    uses when capacity divides the fuzz axis) must not change a single
+    bit vs the replicated table — the global cumsum is the same sum."""
+    target, tables, fmt, dt, m = env
+    B = 16
+    a_cid, a_sval, a_data, weights = _arena_inputs(
+        env, jax.random.PRNGKey(47))
+    outs = {}
+    for shard_weights in (False, True):
+        step, shardings = pmesh.make_arena_fuzz_step(
+            m, dt, batch=B, donate=False, shard_weights=shard_weights)
+        w = jax.device_put(jnp.asarray(np.asarray(weights)),
+                           shardings["weights"])
+        sig = jax.device_put(jnp.zeros(NBITS // 32, jnp.uint32),
+                             shardings["signal"])
+        bloom = jax.device_put(jnp.zeros(NBITS // 32, jnp.uint32),
+                               shardings["bloom"])
+        out = step(jax.random.PRNGKey(53), a_cid, a_sval, a_data, w,
+                   sig, bloom)
+        outs[shard_weights] = [np.asarray(x) for x in out]
+    for name, a, b in zip(ARENA_OUT_NAMES, outs[False], outs[True]):
+        np.testing.assert_array_equal(
+            a, b, err_msg=f"sharded-weights output {name} diverged")
+
+
 def test_fingerprints_mask_dead_calls(env):
     target, tables, fmt, dt, m = env
     cid = jnp.array([1, 2, -1, -1], jnp.int32)

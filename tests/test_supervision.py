@@ -676,6 +676,83 @@ def test_checkpoint_restores_inflight_device_batch(tmp_path, target):
 
 
 @pytest.mark.chaos
+def test_checkpoint_restores_depth3_inflight_ring(tmp_path, target):
+    """ALL k in-flight batches ride the checkpoint: with
+    pipeline_depth=3 a kill + resume restores EVERY staged slot
+    bit-identically and in launch order — exact staged-candidate
+    continuity, not just the oldest slot the old double buffer
+    carried."""
+    pytest.importorskip("jax")
+    np = pytest.importorskip("numpy")
+
+    cfg = dict(mock=True, use_device=True, device_batch=8,
+               device_period=2, pipeline_depth=3, smash_mutations=1,
+               program_length=8, workdir=str(tmp_path),
+               checkpoint_interval=0)
+    with mk(target, **cfg) as f:
+        for _ in range(600):
+            f.step()
+            if f._device is not None and len(f._device._inflight) >= 3:
+                break
+        assert len(f._device._inflight) == 3, "ring never filled to depth"
+        g_inflight = get_registry().get("device_pipeline_inflight")
+        assert g_inflight is not None and g_inflight.value == 3
+        f.save_checkpoint()
+        want = [[np.asarray(x).copy() for x in slot.outs]
+                for slot in f._device._inflight]
+        want_ages = [slot.ages.copy() for slot in f._device._inflight]
+    with mk(target, resume=True, **cfg) as g:
+        slots = list(g._device._inflight)
+        assert len(slots) == 3, "in-flight ring lost slots on resume"
+        for i, slot in enumerate(slots):
+            for a, b in zip(slot.outs, want[i]):
+                assert np.array_equal(np.asarray(a), b), \
+                    f"slot {i} staged batch diverged on resume"
+            assert np.array_equal(slot.ages, want_ages[i]), \
+                f"slot {i} age-stamp snapshot diverged on resume"
+        # the resumed pipeline drains the restored slots as its next
+        # batches (host arrays always test ready: oldest-first order)
+        before = g.stats["device_batches"]
+        for _ in range(400):
+            g.step()
+            if g.stats["device_batches"] > before or \
+                    g.stats.get("device_dropped_stale", 0) > 0 or \
+                    g.stats.get("device_deduped", 0) > 0:
+                break
+        assert (g.stats["device_batches"] > before
+                or g.stats.get("device_dropped_stale", 0) > 0
+                or g.stats.get("device_deduped", 0) > 0), \
+            "restored in-flight slots were never consumed"
+
+
+def test_restore_accepts_legacy_single_pending_checkpoint(target):
+    """Pre-pipeline checkpoints staged at most ONE batch under
+    "pending"/"pending_ages"; the depth-k ring restore must accept them
+    as a one-slot ring."""
+    pytest.importorskip("jax")
+    np = pytest.importorskip("numpy")
+
+    cfg = FuzzerConfig(mock=True, use_device=True, device_batch=8,
+                       program_length=8, smash_mutations=1,
+                       device_period=2)
+    with Fuzzer(target, cfg) as f:
+        for _ in range(400):
+            f.step()
+            if f._device._pending is not None:
+                break
+        assert f._device._pending is not None
+        st = f._device.checkpoint_state()
+        slot = st.pop("inflight")[0]
+        st["pending"] = slot["outs"]
+        st["pending_ages"] = slot["ages"]
+        f._device.restore_state(st)
+        assert len(f._device._inflight) == 1
+        for a, b in zip(f._device._inflight[0].outs, slot["outs"]):
+            assert np.array_equal(np.asarray(a), b)
+        assert np.array_equal(f._device._inflight[0].ages, slot["ages"])
+
+
+@pytest.mark.chaos
 @pytest.mark.slow
 def test_soak_kill_resume_cycles_under_random_faults(tmp_path, target):
     """Long-soak variant (excluded from tier-1): repeated kill/resume
@@ -746,6 +823,69 @@ def test_device_step_poison_is_retried(target):
         assert f.stats["device_candidates"] >= 8
         assert not f._device.degraded
     assert _counter("device_step_retries_total") == before + 1
+
+
+@pytest.mark.chaos
+def test_depth2_step_poison_preserves_staged_slots(target):
+    """Regression for the depth>1 healing bug: a poisoned launch while
+    other batches are already staged must be retried by the per-slot
+    ladder WITHOUT losing the earlier in-flight slots or degrading the
+    pipeline.  Occurrence 3 of device.step is a refill launch — by then
+    at least one healthy batch is staged in the ring."""
+    pytest.importorskip("jax")
+    plan = FaultPlan().fail_at("device.step", 3)
+    faults.install(plan)
+    before = _counter("device_step_retries_total")
+    cfg = FuzzerConfig(mock=True, use_device=True, device_batch=8,
+                       program_length=8, smash_mutations=1,
+                       device_period=4, pipeline_depth=2)
+    with Fuzzer(target, cfg) as f:
+        for _ in range(400):
+            f.step()
+            if f.stats.get("device_candidates", 0) >= 8:
+                break
+        assert ("device.step", 3) in plan.fired(), "poison never fired"
+        assert f.stats["device_candidates"] >= 8, \
+            "staged batches lost after mid-flight poison"
+        assert not f._device.degraded
+        assert len(f._device._inflight) > 0
+    assert _counter("device_step_retries_total") == before + 1
+
+
+@pytest.mark.chaos
+def test_heal_inflight_drops_only_poisoned_slots(target):
+    """A mid-flight device failure can kill buffers belonging to ANY
+    staged slot, not just the newest launch's: _heal_inflight must walk
+    every slot, drop the ones whose outputs died (their drain would
+    raise), and keep the healthy ones — then the campaign continues."""
+    jax = pytest.importorskip("jax")
+    cfg = FuzzerConfig(mock=True, use_device=True, device_batch=8,
+                       program_length=8, smash_mutations=1,
+                       device_period=2, pipeline_depth=3)
+    with Fuzzer(target, cfg) as f:
+        for _ in range(600):
+            f.step()
+            if len(f._device._inflight) >= 3:
+                break
+        dev = f._device
+        assert len(dev._inflight) == 3
+        victim = dev._inflight[1]
+        for x in victim.outs:
+            if isinstance(x, jax.Array):
+                x.delete()
+        survivors = [dev._inflight[0], dev._inflight[2]]
+        dev._heal_inflight()
+        assert victim not in dev._inflight, "poisoned slot kept"
+        assert list(dev._inflight) == survivors, "healthy slot dropped"
+        # consume + refill still work: the campaign continues
+        before = f.stats["device_batches"]
+        for _ in range(400):
+            f.step()
+            if f.stats["device_batches"] > before or \
+                    f.stats.get("device_dropped_stale", 0) > 0 or \
+                    f.stats.get("device_deduped", 0) > 0:
+                break
+        assert not dev.degraded
 
 
 @pytest.mark.chaos

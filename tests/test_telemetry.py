@@ -494,6 +494,19 @@ def test_bench_json_line_schema(monkeypatch, capsys):
                                  "arena_weighted_evictions_total": 0}
                         for c in bench.ARENA_SWEEP_CAPACITIES})
     monkeypatch.setattr(bench, "bench_hub", lambda: 50.0)
+    # async pipelined device step sweep (ISSUE 18): per-(batch, depth)
+    # cells; a pre-pipeline harness nulls the depth!=1 cells
+    monkeypatch.setattr(
+        bench, "bench_pipeline_depth_sweep",
+        lambda target: {
+            "has_pipeline_depth": True,
+            **{f"b{b}_d{d}": {
+                "execs_per_sec": 10.0 * d, "new_inputs": 2,
+                "execs_per_new_input": 5.0, "stall_rate": 0.0,
+                "stalls": 0, "overlap_ratio": 1.0 + d,
+                "inflight_end": d}
+               for b in bench.PIPELINE_SWEEP_BATCHES
+               for d in bench.PIPELINE_SWEEP_DEPTHS}})
 
     bench.main([])
     line = capsys.readouterr().out.strip().splitlines()[-1]
@@ -522,6 +535,15 @@ def test_bench_json_line_schema(monkeypatch, capsys):
     psweep = doc["configs"]["prefix_depth_sweep"]
     for n in bench.PREFIX_SWEEP_LENGTHS:
         assert "calls_reduction" in psweep[f"len{n}"]
+    # pipeline_depth_sweep: every (batch, depth) cell carries the
+    # throughput AND the honesty numbers (stall rate, span overlap)
+    plsweep = doc["configs"]["pipeline_depth_sweep"]
+    assert plsweep["has_pipeline_depth"] is True
+    for b in bench.PIPELINE_SWEEP_BATCHES:
+        for d in bench.PIPELINE_SWEEP_DEPTHS:
+            cell = plsweep[f"b{b}_d{d}"]
+            assert {"execs_per_sec", "stall_rate",
+                    "overlap_ratio"} <= set(cell)
     # cover_merge_sweep: every (nbits, traces) cell carries all three
     # paths (fused may be None on a pre-ISSUE 8 engine — not here)
     csweep = doc["configs"]["cover_merge_sweep"]
@@ -536,7 +558,8 @@ def test_bench_json_line_schema(monkeypatch, capsys):
         mb["sequential"]["serial_roundtrips_per_item"]
     for name in ("mutate", "cover_merge_sweep", "minimize_bisect",
                  "hints_100k", "e2e_triage", "hlo_e2e", "arena_sweep",
-                 "hub_sync", "prefix_depth_sweep"):
+                 "hub_sync", "prefix_depth_sweep",
+                 "pipeline_depth_sweep"):
         cfg = doc["configs"][name]
         assert "error" not in cfg
         spans = cfg["spans"]
